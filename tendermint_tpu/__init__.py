@@ -1,0 +1,30 @@
+"""tendermint_tpu — a TPU-native BFT state-machine-replication framework.
+
+A from-scratch framework with the capabilities of Tendermint Core (BFT
+consensus, ABCI application interface, block/state/light sync, p2p gossip,
+RPC, light client, remote signing) whose compute-critical path — batch
+signature verification — runs as vmapped JAX kernels on TPU, sharded over a
+`jax.sharding.Mesh` for multi-chip scale.
+
+Layer map (mirrors the reference's structure, SURVEY.md §1, but the design is
+idiomatic Python-asyncio for the host control plane and JAX/XLA for compute):
+
+  libs/       service lifecycle, event bus, bit arrays, deterministic codec
+  crypto/     key types, merkle, batch-verifier dispatch; crypto/tpu/ holds
+              the JAX field/curve arithmetic and the batched verify kernel
+  types/      Block, Header, Commit, Vote, ValidatorSet, VoteSet, validation
+  abci/       Application interface + local client + example apps
+  state/      State, BlockExecutor, state store
+  store/      block store + KV database abstraction
+  mempool/    tx pool with priority ordering + LRU cache
+  consensus/  the Tendermint state machine, WAL, replay, reactor
+  privval/    file-based and remote private validators
+  p2p/        transport abstraction (in-memory + TCP), router, peer manager
+  blocksync/  fast block replay with range-batched TPU verification
+  statesync/  snapshot restore + backfill
+  light/      light client verifier / client / proxy
+  rpc/        JSON-RPC + websocket server and client
+  node/       node assembly
+"""
+
+__version__ = "0.1.0"

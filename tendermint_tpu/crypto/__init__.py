@@ -1,0 +1,83 @@
+"""Crypto core: key/signature interfaces and batch-verifier dispatch.
+
+Mirrors the surface of the reference's crypto package (reference
+crypto/crypto.go:22-54): `PubKey`, `PrivKey`, and the two-method
+`BatchVerifier` (`add`, `verify`) that the whole commit-verification funnel
+gates on. The TPU implementation registers behind the same interface
+(crypto/tpu/), so consensus, block-sync, state-sync, and the light client are
+agnostic to where verification executes.
+"""
+
+from __future__ import annotations
+
+import abc
+
+
+class PubKey(abc.ABC):
+    TYPE: str = ""
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool: ...
+
+    def address(self) -> bytes:
+        from .hashes import address
+
+        return address(self.bytes())
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, PubKey)
+            and self.TYPE == other.TYPE
+            and self.bytes() == other.bytes()
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.TYPE, self.bytes()))
+
+    def __repr__(self) -> str:
+        return f"PubKey{{{self.TYPE}:{self.bytes().hex()[:16]}…}}"
+
+
+class PrivKey(abc.ABC):
+    TYPE: str = ""
+
+    @abc.abstractmethod
+    def bytes(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def sign(self, msg: bytes) -> bytes: ...
+
+    @abc.abstractmethod
+    def pub_key(self) -> PubKey: ...
+
+
+class BatchVerifier(abc.ABC):
+    """Accumulate (pubkey, msg, sig) triples, verify them in one shot.
+
+    `verify` returns (all_ok, per_item_validity) — the same contract as the
+    reference (crypto/crypto.go:46-54)."""
+
+    @abc.abstractmethod
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def verify(self) -> tuple[bool, list[bool]]: ...
+
+
+# registry: key type name -> (pubkey codec, batch verifier factory)
+_PUBKEY_DECODERS: dict[str, callable] = {}
+
+
+def register_pubkey_type(type_name: str, decoder) -> None:
+    _PUBKEY_DECODERS[type_name] = decoder
+
+
+def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
+    try:
+        dec = _PUBKEY_DECODERS[type_name]
+    except KeyError:
+        raise ValueError(f"unknown pubkey type {type_name!r}") from None
+    return dec(data)

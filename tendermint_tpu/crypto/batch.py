@@ -1,0 +1,62 @@
+"""Batch-verifier dispatch (analog of reference crypto/batch/batch.go:11-31).
+
+`create_batch_verifier(pubkey)` returns the best available batch verifier for
+the key type: the TPU-backed JAX verifier for ed25519 when a TPU/accelerator
+backend is usable, otherwise a CPU loop verifier. secp256k1 does not support
+batching (matching the reference) — callers fall back to single verification.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import BatchVerifier, PubKey
+from .ed25519 import KEY_TYPE as ED25519
+
+
+class CPUBatchVerifier(BatchVerifier):
+    """Verify each entry independently on the host."""
+
+    def __init__(self):
+        self._items: list[tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        results = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        return all(results) and bool(results), results
+
+
+_tpu_available: bool | None = None
+
+
+def tpu_verifier_available() -> bool:
+    """True when a JAX accelerator (or forced CPU-jax) backend is usable for
+    batched verification. Cached; disable with TMTPU_DISABLE_TPU=1."""
+    global _tpu_available
+    if _tpu_available is None:
+        if os.environ.get("TMTPU_DISABLE_TPU"):
+            _tpu_available = False
+        else:
+            try:
+                from .tpu.verify import backend_ready
+
+                _tpu_available = backend_ready()
+            except Exception:
+                _tpu_available = False
+    return _tpu_available
+
+
+def supports_batch_verifier(pub_key: PubKey) -> bool:
+    return pub_key.TYPE == ED25519
+
+
+def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
+    if pub_key.TYPE == ED25519 and tpu_verifier_available():
+        from .tpu.verify import TPUBatchVerifier
+
+        return TPUBatchVerifier()
+    if supports_batch_verifier(pub_key):
+        return CPUBatchVerifier()
+    raise ValueError(f"key type {pub_key.TYPE!r} does not support batch verification")

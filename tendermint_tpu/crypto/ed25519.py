@@ -1,0 +1,85 @@
+"""Ed25519 key types (analog of reference crypto/ed25519/ed25519.go).
+
+Signing and the fast-path verification use the OpenSSL-backed `cryptography`
+package; consensus-facing verification follows ZIP-215 semantics (reference
+crypto/ed25519/ed25519.go:26-28): OpenSSL's (cofactorless, canonical-only)
+accept set is a strict subset of ZIP-215's, so an OpenSSL accept is final and
+an OpenSSL reject falls back to the pure-Python cofactored verifier in
+ed25519_math.py. Batch verification is dispatched through crypto/batch.py and
+runs on TPU when available (crypto/tpu/)."""
+
+from __future__ import annotations
+
+import secrets
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    NoEncryption,
+    PrivateFormat,
+    PublicFormat,
+)
+
+from . import PrivKey, PubKey, register_pubkey_type
+from . import ed25519_math
+
+KEY_TYPE = "ed25519"
+PUBKEY_SIZE = 32
+PRIVKEY_SIZE = 32  # seed
+SIGNATURE_SIZE = 64
+
+
+class Ed25519PubKey(PubKey):
+    TYPE = KEY_TYPE
+
+    def __init__(self, data: bytes):
+        if len(data) != PUBKEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUBKEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            Ed25519PublicKey.from_public_bytes(self._bytes).verify(sig, msg)
+            return True
+        except (InvalidSignature, ValueError):
+            # OpenSSL rejects some ZIP-215-valid signatures (non-canonical R/A
+            # encodings, mixed-order points); re-check cofactored.
+            return ed25519_math.verify_zip215(self._bytes, msg, sig)
+
+
+class Ed25519PrivKey(PrivKey):
+    TYPE = KEY_TYPE
+
+    def __init__(self, seed: bytes):
+        if len(seed) != PRIVKEY_SIZE:
+            raise ValueError(f"ed25519 privkey seed must be {PRIVKEY_SIZE} bytes")
+        self._seed = bytes(seed)
+        self._sk = Ed25519PrivateKey.from_private_bytes(self._seed)
+        self._pub = self._sk.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw
+        )
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivKey":
+        return cls(secrets.token_bytes(PRIVKEY_SIZE))
+
+    def bytes(self) -> bytes:
+        return self._seed
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._sk.sign(msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(self._pub)
+
+
+register_pubkey_type(KEY_TYPE, Ed25519PubKey)

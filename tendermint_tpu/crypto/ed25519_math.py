@@ -1,0 +1,186 @@
+"""Pure-Python ed25519 group arithmetic with ZIP-215 verification semantics.
+
+This module is the *correctness oracle* for the TPU kernel
+(crypto/tpu/) and the fallback verifier when the `cryptography` backend's
+semantics differ from consensus requirements. The reference gets ZIP-215
+semantics from curve25519-voi (reference crypto/ed25519/ed25519.go:26-28);
+here they are implemented from the curve equations directly:
+
+  * R and A may be ANY 32-byte string that decompresses onto the curve —
+    non-canonical field encodings (y >= p) are accepted, as are small-order
+    and mixed-order points.
+  * s must be canonical: s < L.
+  * the verification equation is cofactored: [8][s]B == [8]R + [8][k]A,
+    k = SHA-512(R || A || msg) interpreted little-endian mod L.
+
+Everything uses extended twisted-Edwards coordinates (X:Y:Z:T), x*y = T*Z/Z^2,
+with the complete addition formulas, so no special-casing of doublings or the
+identity is needed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+D = (-121665 * pow(121666, P - 2, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+
+# base point: y = 4/5, x recovered with even sign
+_BY = (4 * pow(5, P - 2, P)) % P
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    """Solve x^2 = (y^2-1)/(d*y^2+1); return None if no root exists."""
+    y2 = y * y % P
+    u = (y2 - 1) % P
+    v = (D * y2 + 1) % P
+    # candidate root: (u/v)^((p+3)/8) = u * v^3 * (u * v^7)^((p-5)/8)
+    x = u * pow(v, 3, P) % P * pow(u * pow(v, 7, P) % P, (P - 5) // 8, P) % P
+    vx2 = v * x % P * x % P
+    if vx2 == u:
+        pass
+    elif vx2 == (-u) % P:
+        x = x * SQRT_M1 % P
+    else:
+        return None
+    if x == 0 and sign == 1:
+        # -0 does not exist; encodings with x=0 and sign bit set are invalid
+        return None
+    if x & 1 != sign:
+        x = P - x
+    return x
+
+
+BX = _recover_x(_BY, 0)
+BASE = None  # set below after Point defined
+
+
+class Point:
+    """Extended-coordinate point (X:Y:Z:T)."""
+
+    __slots__ = ("X", "Y", "Z", "T")
+
+    def __init__(self, X: int, Y: int, Z: int, T: int):
+        self.X, self.Y, self.Z, self.T = X, Y, Z, T
+
+    @classmethod
+    def identity(cls) -> "Point":
+        return cls(0, 1, 1, 0)
+
+    @classmethod
+    def from_affine(cls, x: int, y: int) -> "Point":
+        return cls(x, y, 1, x * y % P)
+
+    @classmethod
+    def decompress(cls, data: bytes) -> "Point | None":
+        """ZIP-215 decompression: y is read little-endian with the top bit as
+        the sign of x, and is NOT required to be canonical (y >= p allowed)."""
+        if len(data) != 32:
+            return None
+        y = int.from_bytes(data, "little")
+        sign = (y >> 255) & 1
+        y &= (1 << 255) - 1
+        y %= P  # non-canonical encodings fold mod p (ZIP-215)
+        x = _recover_x(y, sign)
+        if x is None:
+            return None
+        return cls.from_affine(x, y)
+
+    def compress(self) -> bytes:
+        zinv = pow(self.Z, P - 2, P)
+        x = self.X * zinv % P
+        y = self.Y * zinv % P
+        return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+    def add(self, other: "Point") -> "Point":
+        # complete addition for a=-1 twisted Edwards (RFC 8032 §5.1.4)
+        A = (self.Y - self.X) * (other.Y - other.X) % P
+        B = (self.Y + self.X) * (other.Y + other.X) % P
+        C = self.T * 2 * D % P * other.T % P
+        Dv = self.Z * 2 * other.Z % P
+        E, F, G, H = B - A, Dv - C, Dv + C, B + A
+        return Point(E * F % P, G * H % P, F * G % P, E * H % P)
+
+    def double(self) -> "Point":
+        return self.add(self)
+
+    def neg(self) -> "Point":
+        return Point((-self.X) % P, self.Y, self.Z, (-self.T) % P)
+
+    def scalar_mul(self, k: int) -> "Point":
+        q = Point.identity()
+        base = self
+        while k:
+            if k & 1:
+                q = q.add(base)
+            base = base.double()
+            k >>= 1
+        return q
+
+    def mul_by_cofactor(self) -> "Point":
+        return self.double().double().double()
+
+    def equals(self, other: "Point") -> bool:
+        # cross-multiply to avoid inversions
+        return (
+            (self.X * other.Z - other.X * self.Z) % P == 0
+            and (self.Y * other.Z - other.Y * self.Z) % P == 0
+        )
+
+    def is_identity(self) -> bool:
+        return self.X % P == 0 and (self.Y - self.Z) % P == 0
+
+
+BASE = Point.from_affine(BX, _BY)
+
+
+def scalar_from_hash(r_bytes: bytes, a_bytes: bytes, msg: bytes) -> int:
+    h = hashlib.sha512(r_bytes + a_bytes + msg).digest()
+    return int.from_bytes(h, "little") % L
+
+
+def verify_zip215(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
+    """Cofactored single-signature verification with ZIP-215 acceptance."""
+    if len(sig) != 64 or len(pubkey) != 32:
+        return False
+    r_bytes, s_bytes = sig[:32], sig[32:]
+    s = int.from_bytes(s_bytes, "little")
+    if s >= L:
+        return False
+    A = Point.decompress(pubkey)
+    R = Point.decompress(r_bytes)
+    if A is None or R is None:
+        return False
+    k = scalar_from_hash(r_bytes, pubkey, msg)
+    # [8][s]B == [8]R + [8][k]A
+    lhs = BASE.scalar_mul(s).mul_by_cofactor()
+    rhs = R.add(A.scalar_mul(k)).mul_by_cofactor()
+    return lhs.equals(rhs)
+
+
+def sign(privkey_seed: bytes, msg: bytes) -> bytes:
+    """RFC 8032 signing from a 32-byte seed (oracle/testing use; production
+    signing goes through the `cryptography` backend in ed25519.py)."""
+    h = hashlib.sha512(privkey_seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    prefix = h[32:]
+    A = BASE.scalar_mul(a)
+    a_bytes = A.compress()
+    r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
+    R = BASE.scalar_mul(r)
+    r_bytes = R.compress()
+    k = scalar_from_hash(r_bytes, a_bytes, msg)
+    s = (r + k * a) % L
+    return r_bytes + s.to_bytes(32, "little")
+
+
+def public_from_seed(privkey_seed: bytes) -> bytes:
+    h = hashlib.sha512(privkey_seed).digest()
+    a = int.from_bytes(h[:32], "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return BASE.scalar_mul(a).compress()

@@ -1,0 +1,20 @@
+"""Hash helpers (analog of reference crypto/tmhash).
+
+`sha256` is the framework-wide hash; `address` is the 20-byte truncated
+SHA-256 used for validator/account addresses (reference
+crypto/tmhash/hash.go)."""
+
+from __future__ import annotations
+
+import hashlib
+
+HASH_SIZE = 32
+ADDRESS_SIZE = 20
+
+
+def sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def address(pubkey_bytes: bytes) -> bytes:
+    return sha256(pubkey_bytes)[:ADDRESS_SIZE]

@@ -1,0 +1,140 @@
+"""RFC 6962 merkle tree (analog of reference crypto/merkle/tree.go, proof.go).
+
+Leaf hash = SHA-256(0x00 || leaf), inner hash = SHA-256(0x01 || left || right),
+empty tree hash = SHA-256(""). Trees are unbalanced with the split at the
+largest power of two strictly less than n, which makes proofs logarithmic and
+append-friendly."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .hashes import sha256
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def _leaf_hash(leaf: bytes) -> bytes:
+    return sha256(LEAF_PREFIX + leaf)
+
+
+def _inner_hash(left: bytes, right: bytes) -> bytes:
+    return sha256(INNER_PREFIX + left + right)
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n."""
+    k = 1
+    while k * 2 < n:
+        k *= 2
+    return k
+
+
+def hash_from_byte_slices(items: list[bytes]) -> bytes:
+    """Root hash of the merkle tree over `items` (reference crypto/merkle/tree.go:11)."""
+    n = len(items)
+    if n == 0:
+        return sha256(b"")
+    if n == 1:
+        return _leaf_hash(items[0])
+    k = _split_point(n)
+    return _inner_hash(
+        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
+    )
+
+
+@dataclass
+class Proof:
+    """Inclusion proof for item `index` of `total` with sibling hashes
+    root-ward in `aunts` (reference crypto/merkle/proof.go:26)."""
+
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes]
+
+    def verify(self, root: bytes, leaf: bytes) -> bool:
+        if self.total < 0 or not 0 <= self.index < max(self.total, 1):
+            return False
+        if _leaf_hash(leaf) != self.leaf_hash:
+            return False
+        computed = _compute_root(self.leaf_hash, self.index, self.total, self.aunts)
+        return computed == root
+
+    def encode(self) -> bytes:
+        from ..libs import protoenc as pe
+
+        out = pe.varint_field(1, self.total) + pe.varint_field(2, self.index)
+        out += pe.bytes_field(3, self.leaf_hash)
+        for a in self.aunts:
+            out += pe.message_field(4, a)
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Proof":
+        from ..libs import protoenc as pe
+
+        r = pe.Reader(data)
+        total = index = 0
+        leaf_hash = b""
+        aunts: list[bytes] = []
+        while not r.eof():
+            field, wt = r.read_tag()
+            if field == 1:
+                total = r.read_uvarint()
+            elif field == 2:
+                index = r.read_uvarint()
+            elif field == 3:
+                leaf_hash = r.read_bytes()
+            elif field == 4:
+                aunts.append(r.read_bytes())
+            else:
+                r.skip(wt)
+        return cls(total=total, index=index, leaf_hash=leaf_hash, aunts=aunts)
+
+
+def _compute_root(leaf_hash: bytes, index: int, total: int, aunts: list[bytes]) -> bytes | None:
+    if total == 0:
+        return None
+    if total == 1:
+        return leaf_hash if not aunts else None
+    if not aunts:
+        return None
+    k = _split_point(total)
+    if index < k:
+        left = _compute_root(leaf_hash, index, k, aunts[:-1])
+        if left is None:
+            return None
+        return _inner_hash(left, aunts[-1])
+    right = _compute_root(leaf_hash, index - k, total - k, aunts[:-1])
+    if right is None:
+        return None
+    return _inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """Build the tree and an inclusion proof per item."""
+    n = len(items)
+    leaf_hashes = [_leaf_hash(it) for it in items]
+
+    def build(lo: int, hi: int) -> tuple[bytes, dict[int, list[bytes]]]:
+        count = hi - lo
+        if count == 0:
+            return sha256(b""), {}
+        if count == 1:
+            return leaf_hashes[lo], {lo: []}
+        k = _split_point(count)
+        lroot, lpaths = build(lo, lo + k)
+        rroot, rpaths = build(lo + k, hi)
+        for paths, sibling in ((lpaths, rroot), (rpaths, lroot)):
+            for aunts in paths.values():
+                aunts.append(sibling)
+        return _inner_hash(lroot, rroot), {**lpaths, **rpaths}
+
+    root, paths = build(0, n)
+    proofs = [
+        Proof(total=n, index=i, leaf_hash=leaf_hashes[i], aunts=paths.get(i, []))
+        for i in range(n)
+    ]
+    return root, proofs

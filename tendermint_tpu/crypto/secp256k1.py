@@ -1,0 +1,110 @@
+"""secp256k1 ECDSA key types (analog of reference crypto/secp256k1).
+
+Signatures are 64-byte compact (r||s, 32 bytes each, big-endian) with the
+low-S malleability rule enforced on both sign and verify, matching the
+reference (crypto/secp256k1/secp256k1_nocgo.go:21-48). Public keys are
+33-byte compressed SEC1. Like the reference, secp256k1 has no batch verifier
+in round 1 — commits fall back to single verification (the TPU ECDSA-recover
+kernel is a later milestone, see BASELINE.md config 4)."""
+
+from __future__ import annotations
+
+import secrets
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes as crypto_hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
+
+from . import PrivKey, PubKey, register_pubkey_type
+from .hashes import sha256
+
+KEY_TYPE = "secp256k1"
+PUBKEY_SIZE = 33
+PRIVKEY_SIZE = 32
+SIGNATURE_SIZE = 64
+
+# curve order
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+HALF_N = N // 2
+
+
+class Secp256k1PubKey(PubKey):
+    TYPE = KEY_TYPE
+
+    def __init__(self, data: bytes):
+        if len(data) != PUBKEY_SIZE:
+            raise ValueError(f"secp256k1 pubkey must be {PUBKEY_SIZE} bytes")
+        self._bytes = bytes(data)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (0 < r < N and 0 < s <= HALF_N):  # reject high-S (malleability)
+            return False
+        try:
+            pub = ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256K1(), self._bytes
+            )
+            pub.verify(
+                encode_dss_signature(r, s),
+                sha256(msg),
+                ec.ECDSA(Prehashed(crypto_hashes.SHA256())),
+            )
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+class Secp256k1PrivKey(PrivKey):
+    TYPE = KEY_TYPE
+
+    def __init__(self, data: bytes):
+        if len(data) != PRIVKEY_SIZE:
+            raise ValueError(f"secp256k1 privkey must be {PRIVKEY_SIZE} bytes")
+        self._bytes = bytes(data)
+        self._sk = ec.derive_private_key(
+            int.from_bytes(data, "big"), ec.SECP256K1()
+        )
+        self._pub = self._sk.public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint
+        )
+
+    @classmethod
+    def generate(cls) -> "Secp256k1PrivKey":
+        while True:
+            d = secrets.token_bytes(PRIVKEY_SIZE)
+            v = int.from_bytes(d, "big")
+            if 0 < v < N:
+                return cls(d)
+
+    def bytes(self) -> bytes:
+        return self._bytes
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._sk.sign(
+            sha256(msg), ec.ECDSA(Prehashed(crypto_hashes.SHA256()))
+        )
+        r, s = decode_dss_signature(der)
+        if s > HALF_N:
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1PubKey:
+        return Secp256k1PubKey(self._pub)
+
+
+register_pubkey_type(KEY_TYPE, Secp256k1PubKey)

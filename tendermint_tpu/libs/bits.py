@@ -1,0 +1,120 @@
+"""BitArray — thread-compatible bit vector used for vote bookkeeping and
+block-part tracking (analog of reference libs/bits/bit_array.go)."""
+
+from __future__ import annotations
+
+import secrets
+
+
+class BitArray:
+    __slots__ = ("size", "_bits")
+
+    def __init__(self, size: int):
+        if size < 0:
+            raise ValueError("negative BitArray size")
+        self.size = size
+        self._bits = bytearray((size + 7) // 8)
+
+    @classmethod
+    def from_indices(cls, size: int, indices) -> "BitArray":
+        ba = cls(size)
+        for i in indices:
+            ba.set(i, True)
+        return ba
+
+    def get(self, i: int) -> bool:
+        if not 0 <= i < self.size:
+            return False
+        return bool(self._bits[i >> 3] & (1 << (i & 7)))
+
+    def set(self, i: int, value: bool) -> bool:
+        if not 0 <= i < self.size:
+            return False
+        if value:
+            self._bits[i >> 3] |= 1 << (i & 7)
+        else:
+            self._bits[i >> 3] &= ~(1 << (i & 7))
+        return True
+
+    def copy(self) -> "BitArray":
+        ba = BitArray(self.size)
+        ba._bits = bytearray(self._bits)
+        return ba
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        out = BitArray(max(self.size, other.size))
+        for i in range(len(out._bits)):
+            a = self._bits[i] if i < len(self._bits) else 0
+            b = other._bits[i] if i < len(other._bits) else 0
+            out._bits[i] = a | b
+        return out
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        out = BitArray(min(self.size, other.size))
+        for i in range(len(out._bits)):
+            out._bits[i] = self._bits[i] & other._bits[i]
+        return out
+
+    def not_(self) -> "BitArray":
+        out = BitArray(self.size)
+        for i in range(len(out._bits)):
+            out._bits[i] = ~self._bits[i] & 0xFF
+        # clear padding bits beyond size
+        extra = len(out._bits) * 8 - out.size
+        if extra:
+            out._bits[-1] &= 0xFF >> extra
+        return out
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other."""
+        out = self.copy()
+        n = min(len(self._bits), len(other._bits))
+        for i in range(n):
+            out._bits[i] &= ~other._bits[i] & 0xFF
+        return out
+
+    def is_empty(self) -> bool:
+        return not any(self._bits)
+
+    def is_full(self) -> bool:
+        if self.size == 0:
+            return True
+        full, extra = divmod(self.size, 8)
+        if any(b != 0xFF for b in self._bits[:full]):
+            return False
+        if extra:
+            return self._bits[full] == (0xFF >> (8 - extra))
+        return True
+
+    def pick_random(self) -> int | None:
+        """Pick a uniformly random set bit index, or None if empty."""
+        ones = [i for i in range(self.size) if self.get(i)]
+        if not ones:
+            return None
+        return ones[secrets.randbelow(len(ones))]
+
+    def true_indices(self) -> list[int]:
+        return [i for i in range(self.size) if self.get(i)]
+
+    def num_true(self) -> int:
+        return sum(bin(b).count("1") for b in self._bits)
+
+    def to_bytes(self) -> bytes:
+        return bytes(self._bits)
+
+    @classmethod
+    def from_bytes(cls, size: int, data: bytes) -> "BitArray":
+        ba = cls(size)
+        ba._bits[: len(data)] = data[: len(ba._bits)]
+        return ba
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, BitArray)
+            and self.size == other.size
+            and self._bits == other._bits
+        )
+
+    def __repr__(self) -> str:
+        s = "".join("x" if self.get(i) else "_" for i in range(min(self.size, 64)))
+        return f"BitArray{{{s}{'…' if self.size > 64 else ''}}}"

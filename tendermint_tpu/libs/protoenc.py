@@ -1,0 +1,158 @@
+"""Deterministic protobuf-wire-compatible encoder.
+
+The consensus-critical byte strings in this framework (canonical vote
+sign-bytes, header field encodings, hashes) are produced by this module. It
+implements the subset of the protobuf wire format needed for canonical
+encodings — varint, fixed64/sfixed64, and length-delimited fields — with
+strictly deterministic output (fields emitted in ascending tag order, default
+values omitted, no unknown fields).
+
+The reference builds its canonical sign-bytes from gogoproto-generated
+marshalling (reference types/canonical.go:56, sfixed64 height/round); this
+module provides the same determinism guarantees without a codegen step.
+"""
+
+from __future__ import annotations
+
+import struct
+
+WIRE_VARINT = 0
+WIRE_FIXED64 = 1
+WIRE_BYTES = 2
+WIRE_FIXED32 = 5
+
+
+def uvarint(value: int) -> bytes:
+    """Encode an unsigned integer as a protobuf base-128 varint."""
+    if value < 0:
+        raise ValueError("uvarint requires a non-negative value")
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def svarint(value: int) -> bytes:
+    """Zigzag-encoded signed varint."""
+    return uvarint((value << 1) ^ (value >> 63) if value < 0 else value << 1)
+
+
+def tag(field_number: int, wire_type: int) -> bytes:
+    return uvarint((field_number << 3) | wire_type)
+
+
+def varint_field(field_number: int, value: int) -> bytes:
+    """Varint field; 0 is omitted (proto3 default-elision)."""
+    if value == 0:
+        return b""
+    if value < 0:
+        # proto encodes negative int64 as 10-byte two's complement varint
+        value &= (1 << 64) - 1
+    return tag(field_number, WIRE_VARINT) + uvarint(value)
+
+
+def bool_field(field_number: int, value: bool) -> bytes:
+    return varint_field(field_number, 1 if value else 0)
+
+
+def sfixed64_field(field_number: int, value: int) -> bytes:
+    if value == 0:
+        return b""
+    return tag(field_number, WIRE_FIXED64) + struct.pack("<q", value)
+
+
+def fixed64_field(field_number: int, value: int) -> bytes:
+    if value == 0:
+        return b""
+    return tag(field_number, WIRE_FIXED64) + struct.pack("<Q", value)
+
+
+def bytes_field(field_number: int, value: bytes) -> bytes:
+    """Length-delimited field; empty bytes are omitted."""
+    if not value:
+        return b""
+    return tag(field_number, WIRE_BYTES) + uvarint(len(value)) + value
+
+
+def string_field(field_number: int, value: str) -> bytes:
+    return bytes_field(field_number, value.encode("utf-8"))
+
+
+def message_field(field_number: int, encoded: bytes) -> bytes:
+    """Embedded message field. Unlike bytes_field, an empty message is still
+    emitted when explicitly requested (callers pass None to omit)."""
+    return tag(field_number, WIRE_BYTES) + uvarint(len(encoded)) + encoded
+
+
+def len_prefixed(encoded: bytes) -> bytes:
+    """Length-delimit a full message (framing used for streams and hashing)."""
+    return uvarint(len(encoded)) + encoded
+
+
+class Reader:
+    """Minimal wire-format reader for decoding our own encodings."""
+
+    def __init__(self, data: bytes):
+        self.data = data
+        self.pos = 0
+
+    def eof(self) -> bool:
+        return self.pos >= len(self.data)
+
+    def read_uvarint(self) -> int:
+        shift = 0
+        result = 0
+        while True:
+            if self.pos >= len(self.data):
+                raise ValueError("truncated varint")
+            b = self.data[self.pos]
+            self.pos += 1
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+            if shift > 70:
+                raise ValueError("varint too long")
+
+    def read_tag(self) -> tuple[int, int]:
+        v = self.read_uvarint()
+        return v >> 3, v & 0x7
+
+    def read_fixed64(self) -> int:
+        if self.pos + 8 > len(self.data):
+            raise ValueError("truncated fixed64")
+        (v,) = struct.unpack_from("<Q", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def read_sfixed64(self) -> int:
+        if self.pos + 8 > len(self.data):
+            raise ValueError("truncated sfixed64")
+        (v,) = struct.unpack_from("<q", self.data, self.pos)
+        self.pos += 8
+        return v
+
+    def read_bytes(self) -> bytes:
+        n = self.read_uvarint()
+        if self.pos + n > len(self.data):
+            raise ValueError("truncated bytes")
+        v = self.data[self.pos : self.pos + n]
+        self.pos += n
+        return v
+
+    def skip(self, wire_type: int) -> None:
+        if wire_type == WIRE_VARINT:
+            self.read_uvarint()
+        elif wire_type == WIRE_FIXED64:
+            self.pos += 8
+        elif wire_type == WIRE_BYTES:
+            self.read_bytes()
+        elif wire_type == WIRE_FIXED32:
+            self.pos += 4
+        else:
+            raise ValueError(f"unknown wire type {wire_type}")

@@ -1,0 +1,74 @@
+"""Async service lifecycle, the analog of the reference's BaseService
+(reference libs/service/service.go).
+
+A Service can be started once, stopped once, and exposes `wait_stopped()`.
+Subclasses override `on_start` / `on_stop`. Unlike the Go original there is
+no goroutine bookkeeping — asyncio tasks registered via `spawn` are cancelled
+on stop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Coroutine
+
+
+class Service:
+    def __init__(self, name: str | None = None, logger: logging.Logger | None = None):
+        self.name = name or type(self).__name__
+        self.logger = logger or logging.getLogger(self.name)
+        self._started = False
+        self._stopped = asyncio.Event()
+        self._stopping = False
+        self._tasks: list[asyncio.Task] = []
+
+    @property
+    def is_running(self) -> bool:
+        return self._started and not self._stopping
+
+    async def start(self) -> None:
+        if self._started:
+            raise RuntimeError(f"service {self.name} already started")
+        self._started = True
+        self.logger.debug("starting %s", self.name)
+        await self.on_start()
+
+    async def stop(self) -> None:
+        if not self._started or self._stopping:
+            return
+        self._stopping = True
+        self.logger.debug("stopping %s", self.name)
+        await self.on_stop()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        self._stopped.set()
+
+    async def wait_stopped(self) -> None:
+        await self._stopped.wait()
+
+    def spawn(self, coro: Coroutine, name: str | None = None) -> asyncio.Task:
+        """Run a coroutine for the lifetime of the service."""
+        task = asyncio.get_running_loop().create_task(coro, name=name)
+        self._tasks.append(task)
+        task.add_done_callback(self._on_task_done)
+        return task
+
+    def _on_task_done(self, task: asyncio.Task) -> None:
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None and not self._stopping:
+            self.logger.error("task %s crashed: %r", task.get_name(), exc)
+
+    async def on_start(self) -> None:  # override
+        pass
+
+    async def on_stop(self) -> None:  # override
+        pass

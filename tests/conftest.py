@@ -1,0 +1,19 @@
+"""Test configuration.
+
+Sharding/mesh tests run on a virtual 8-device CPU topology: real multi-chip
+TPU hardware is not available in CI, so `jax.sharding.Mesh` code paths are
+validated with `--xla_force_host_platform_device_count=8` on the CPU backend
+(the driver separately dry-runs the multichip path via __graft_entry__).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

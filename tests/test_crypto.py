@@ -1,0 +1,161 @@
+"""Host crypto tests: ed25519 (incl. ZIP-215 oracle vs RFC 8032 backend),
+secp256k1 low-S, merkle tree/proofs, batch verifier dispatch."""
+
+import hashlib
+import secrets
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519, ed25519_math, secp256k1
+from tendermint_tpu.crypto import merkle
+from tendermint_tpu.crypto.batch import (
+    CPUBatchVerifier,
+    create_batch_verifier,
+    supports_batch_verifier,
+)
+from tendermint_tpu.crypto import pubkey_from_type_and_bytes
+from tendermint_tpu.crypto.hashes import address, sha256
+
+
+def test_ed25519_sign_verify():
+    sk = ed25519.Ed25519PrivKey.generate()
+    pk = sk.pub_key()
+    msg = b"consensus is hard"
+    sig = sk.sign(msg)
+    assert pk.verify_signature(msg, sig)
+    assert not pk.verify_signature(msg + b"!", sig)
+    assert not pk.verify_signature(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+    assert not pk.verify_signature(msg, b"short")
+
+
+def test_ed25519_oracle_agrees_with_openssl():
+    for i in range(20):
+        seed = secrets.token_bytes(32)
+        sk = ed25519.Ed25519PrivKey(seed)
+        msg = secrets.token_bytes(i * 7 + 1)
+        sig = sk.sign(msg)
+        # pure-Python signer must produce the identical signature (RFC 8032 determinism)
+        assert ed25519_math.sign(seed, msg) == sig
+        assert ed25519_math.public_from_seed(seed) == sk.pub_key().bytes()
+        assert ed25519_math.verify_zip215(sk.pub_key().bytes(), msg, sig)
+        bad = bytearray(sig)
+        bad[0] ^= 1
+        assert not ed25519_math.verify_zip215(sk.pub_key().bytes(), msg, bytes(bad))
+
+
+def test_ed25519_rejects_noncanonical_s():
+    sk = ed25519.Ed25519PrivKey.generate()
+    msg = b"m"
+    sig = bytearray(sk.sign(msg))
+    s = int.from_bytes(sig[32:], "little")
+    sig[32:] = (s + ed25519_math.L).to_bytes(32, "little")
+    assert not ed25519_math.verify_zip215(sk.pub_key().bytes(), msg, bytes(sig))
+
+
+def test_ed25519_zip215_accepts_noncanonical_point_encoding():
+    # ZIP-215: y-encodings >= p fold mod p. Encoding of p+1 represents y=1,
+    # i.e. the identity point (0, 1).
+    nc = (ed25519_math.P + 1).to_bytes(32, "little")
+    pt = ed25519_math.Point.decompress(nc)
+    assert pt is not None and pt.is_identity()
+    # canonical encoding of the same point decompresses identically
+    assert ed25519_math.Point.decompress((1).to_bytes(32, "little")).is_identity()
+    # but 2^255-19+2 with no curve point at y=2... check a y with no x is rejected
+    # (y=2: x^2=(4-1)/(4d+1); verify rejection matches _recover_x)
+    y2 = ed25519_math.Point.decompress((2).to_bytes(32, "little"))
+    x = ed25519_math._recover_x(2, 0)
+    assert (y2 is None) == (x is None)
+
+
+def test_ed25519_math_base_point():
+    # base point order: L*B == identity
+    assert ed25519_math.BASE.scalar_mul(ed25519_math.L).is_identity()
+    # compress/decompress roundtrip
+    P = ed25519_math.BASE.scalar_mul(12345)
+    assert ed25519_math.Point.decompress(P.compress()).equals(P)
+
+
+def test_secp256k1_sign_verify_low_s():
+    sk = secp256k1.Secp256k1PrivKey.generate()
+    pk = sk.pub_key()
+    msg = b"ecdsa"
+    sig = sk.sign(msg)
+    assert len(sig) == 64
+    s = int.from_bytes(sig[32:], "big")
+    assert s <= secp256k1.HALF_N
+    assert pk.verify_signature(msg, sig)
+    # high-S version must be rejected even though mathematically valid
+    high = sig[:32] + (secp256k1.N - s).to_bytes(32, "big")
+    assert not pk.verify_signature(msg, high)
+    assert not pk.verify_signature(b"other", sig)
+
+
+def test_address_is_truncated_sha256():
+    sk = ed25519.Ed25519PrivKey.generate()
+    pk = sk.pub_key()
+    assert pk.address() == hashlib.sha256(pk.bytes()).digest()[:20]
+    assert len(address(pk.bytes())) == 20
+
+
+def test_pubkey_registry_roundtrip():
+    for sk in [ed25519.Ed25519PrivKey.generate(), secp256k1.Secp256k1PrivKey.generate()]:
+        pk = sk.pub_key()
+        pk2 = pubkey_from_type_and_bytes(pk.TYPE, pk.bytes())
+        assert pk2 == pk
+
+
+def test_merkle_empty_and_single():
+    assert merkle.hash_from_byte_slices([]) == sha256(b"")
+    one = merkle.hash_from_byte_slices([b"x"])
+    assert one == sha256(b"\x00x")
+
+
+def test_merkle_structure():
+    items = [b"a", b"b", b"c"]
+    # split point for 3 is 2: inner(inner(leaf a, leaf b), leaf c)
+    la, lb, lc = (sha256(b"\x00" + i) for i in items)
+    expect = sha256(b"\x01" + sha256(b"\x01" + la + lb) + lc)
+    assert merkle.hash_from_byte_slices(items) == expect
+
+
+def test_merkle_proofs():
+    items = [f"item{i}".encode() for i in range(7)]
+    root, proofs = merkle.proofs_from_byte_slices(items)
+    assert root == merkle.hash_from_byte_slices(items)
+    for i, p in enumerate(proofs):
+        assert p.verify(root, items[i]), i
+        assert not p.verify(root, b"wrong")
+        assert not p.verify(sha256(b"bad root"), items[i])
+        # encode/decode roundtrip
+        p2 = merkle.Proof.decode(p.encode())
+        assert p2.verify(root, items[i])
+
+
+def test_batch_verifier_cpu():
+    bv = CPUBatchVerifier()
+    keys = [ed25519.Ed25519PrivKey.generate() for _ in range(8)]
+    msgs = [f"msg{i}".encode() for i in range(8)]
+    for k, m in zip(keys, msgs):
+        bv.add(k.pub_key(), m, k.sign(m))
+    ok, bits = bv.verify()
+    assert ok and all(bits) and len(bits) == 8
+
+    bv2 = CPUBatchVerifier()
+    for i, (k, m) in enumerate(zip(keys, msgs)):
+        sig = k.sign(m)
+        if i == 3:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])
+        bv2.add(k.pub_key(), m, sig)
+    ok, bits = bv2.verify()
+    assert not ok
+    assert bits == [i != 3 for i in range(8)]
+
+
+def test_batch_dispatch():
+    ed = ed25519.Ed25519PrivKey.generate().pub_key()
+    sec = secp256k1.Secp256k1PrivKey.generate().pub_key()
+    assert supports_batch_verifier(ed)
+    assert not supports_batch_verifier(sec)
+    assert create_batch_verifier(ed) is not None
+    with pytest.raises(ValueError):
+        create_batch_verifier(sec)

@@ -1,0 +1,74 @@
+"""Tests for libs: protoenc determinism/roundtrip, BitArray semantics."""
+
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.libs.bits import BitArray
+
+
+def test_uvarint_roundtrip():
+    for v in [0, 1, 127, 128, 300, 2**32, 2**63 - 1, 2**64 - 1]:
+        r = pe.Reader(pe.uvarint(v))
+        assert r.read_uvarint() == v
+        assert r.eof()
+
+
+def test_varint_field_default_elision():
+    assert pe.varint_field(1, 0) == b""
+    assert pe.bytes_field(2, b"") == b""
+    assert pe.sfixed64_field(3, 0) == b""
+
+
+def test_negative_varint_matches_proto_two_complement():
+    # proto3 int64 -1 encodes as 10 bytes of 0xff...0x01
+    data = pe.varint_field(1, -1)
+    r = pe.Reader(data)
+    field, wt = r.read_tag()
+    assert field == 1 and wt == pe.WIRE_VARINT
+    v = r.read_uvarint()
+    assert v == 2**64 - 1
+
+
+def test_sfixed64_roundtrip():
+    data = pe.sfixed64_field(5, -42)
+    r = pe.Reader(data)
+    field, wt = r.read_tag()
+    assert field == 5 and wt == pe.WIRE_FIXED64
+    assert r.read_sfixed64() == -42
+
+
+def test_message_field_emits_empty():
+    assert pe.message_field(1, b"") != b""
+
+
+def test_bitarray_basic():
+    ba = BitArray(10)
+    assert ba.is_empty() and not ba.is_full()
+    ba.set(3, True)
+    ba.set(9, True)
+    assert ba.get(3) and ba.get(9) and not ba.get(4)
+    assert ba.true_indices() == [3, 9]
+    assert ba.num_true() == 2
+    assert not ba.set(10, True)  # out of range
+    assert not ba.get(100)
+
+
+def test_bitarray_full_and_not():
+    ba = BitArray(9)
+    for i in range(9):
+        ba.set(i, True)
+    assert ba.is_full()
+    inv = ba.not_()
+    assert inv.is_empty()
+
+
+def test_bitarray_sub_or():
+    a = BitArray.from_indices(8, [0, 1, 2])
+    b = BitArray.from_indices(8, [1, 3])
+    assert a.sub(b).true_indices() == [0, 2]
+    assert a.or_(b).true_indices() == [0, 1, 2, 3]
+    assert a.and_(b).true_indices() == [1]
+
+
+def test_bitarray_bytes_roundtrip():
+    a = BitArray.from_indices(20, [0, 13, 19])
+    b = BitArray.from_bytes(20, a.to_bytes())
+    assert a == b
