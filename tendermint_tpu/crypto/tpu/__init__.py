@@ -1,0 +1,17 @@
+"""TPU-native crypto kernels.
+
+This package holds the JAX implementation of batched ed25519 verification —
+the compute hot path of the whole framework (the reference's equivalent is
+the curve25519-voi batch verifier behind crypto.BatchVerifier, reference
+crypto/ed25519/ed25519.go:195-227). Everything here is designed for XLA:
+
+  * field elements of GF(2^255-19) are vectors of 32 radix-2^8 limbs held in
+    int32 lanes — products of partially-reduced limbs stay below 2^31, so no
+    64-bit emulation is needed and every op vectorizes over the batch axis;
+  * scalar multiplication is a `lax.scan` over the 256 scalar bits with
+    complete (unified) twisted-Edwards addition formulas, so there is no
+    data-dependent control flow anywhere;
+  * batches shard over a `jax.sharding.Mesh` data axis — signature
+    verification is embarrassingly data-parallel, the multi-chip story is a
+    one-line sharding annotation (see verify.py).
+"""

@@ -1,0 +1,186 @@
+"""Batched twisted-Edwards curve ops for ed25519 on TPU.
+
+Points are extended coordinates (X:Y:Z:T) with each coordinate a limb vector
+(see field.py), batched over leading axes. The addition formula is the
+complete (unified) one for a=-1 twisted Edwards curves — valid for doubling,
+the identity, and order-2 points alike, so the scalar-multiplication scan has
+no branches.
+
+Decompression implements ZIP-215 acceptance (reference semantics,
+crypto/ed25519/ed25519.go:26-28 via curve25519-voi): non-canonical y
+encodings fold mod p; x is recovered with the (p+3)/8 candidate-root method;
+encodings with no square root, or x=0 with the sign bit set, are invalid.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import field as F
+
+
+class Point(NamedTuple):
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+    t: jnp.ndarray
+
+
+def identity(batch_shape=()) -> Point:
+    z = jnp.zeros(batch_shape + (F.LIMBS,), jnp.int32)
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), batch_shape + (F.LIMBS,))
+    return Point(z, one, one, z)
+
+
+# affine base point B = (x, 4/5)
+_BY_INT = (4 * pow(5, F.P_INT - 2, F.P_INT)) % F.P_INT
+
+
+def _recover_x_int(y: int, sign: int) -> int:
+    p, d = F.P_INT, F.D_INT
+    u, v = (y * y - 1) % p, (d * y * y + 1) % p
+    x = u * pow(v, 3, p) % p * pow(u * pow(v, 7, p) % p, (p - 5) // 8, p) % p
+    if v * x * x % p == (-u) % p:
+        x = x * F.SQRT_M1_INT % p
+    if x & 1 != sign:
+        x = p - x
+    return x
+
+
+_BX_INT = _recover_x_int(_BY_INT, 0)
+BASE_X = F.int_to_limbs(_BX_INT)
+BASE_Y = F.int_to_limbs(_BY_INT)
+BASE_T = F.int_to_limbs(_BX_INT * _BY_INT % F.P_INT)
+
+
+def base_point(batch_shape=()) -> Point:
+    bc = lambda a: jnp.broadcast_to(jnp.asarray(a), batch_shape + (F.LIMBS,))
+    return Point(bc(BASE_X), bc(BASE_Y), bc(F.ONE), bc(BASE_T))
+
+
+def point_add(p: Point, q: Point) -> Point:
+    """Complete addition (RFC 8032 §5.1.4 'add-2008-hwcd-3')."""
+    a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
+    b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
+    c = F.mul(F.mul(p.t, jnp.asarray(F.D2_LIMBS)), q.t)
+    d = F.mul(F.mul_scalar(p.z, 2), q.z)
+    e = F.sub(b, a)
+    f = F.sub(d, c)
+    g = F.add_c(d, c)
+    h = F.add_c(b, a)
+    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+
+
+def point_double(p: Point) -> Point:
+    return point_add(p, p)
+
+
+def point_neg(p: Point) -> Point:
+    return Point(F.neg(p.x), p.y, p.z, F.neg(p.t))
+
+
+def point_select(mask: jnp.ndarray, p: Point, q: Point) -> Point:
+    """Elementwise select: mask True -> p, False -> q. mask shape = batch."""
+    m = mask[..., None]
+    return Point(
+        jnp.where(m, p.x, q.x),
+        jnp.where(m, p.y, q.y),
+        jnp.where(m, p.z, q.z),
+        jnp.where(m, p.t, q.t),
+    )
+
+
+def point_eq(p: Point, q: Point) -> jnp.ndarray:
+    """Projective equality: X1·Z2 == X2·Z1 and Y1·Z2 == Y2·Z1."""
+    return F.eq(F.mul(p.x, q.z), F.mul(q.x, p.z)) & F.eq(
+        F.mul(p.y, q.z), F.mul(q.y, p.z)
+    )
+
+
+def is_identity(p: Point) -> jnp.ndarray:
+    return F.is_zero(p.x) & F.eq(p.y, p.z)
+
+
+def mul_by_cofactor(p: Point) -> Point:
+    return point_double(point_double(point_double(p)))
+
+
+def decompress(y_bytes: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
+    """ZIP-215 point decompression.
+
+    y_bytes: (..., 32) int32 byte limbs of the encoded point.
+    Returns (Point, valid) — where invalid, the point's coordinates are
+    well-defined garbage (callers must mask with `valid`)."""
+    sign = (y_bytes[..., 31] >> 7) & 1
+    y = y_bytes.at[..., 31].set(y_bytes[..., 31] & 0x7F)
+    # fold non-canonical encodings: y < 2^255 < 2p, so subtract p at most once
+    w = F.canonical(y)  # here y < p+? — canonical() handles the conditional subtract
+    y = w
+
+    y2 = F.square(y)
+    u = F.sub(y2, jnp.asarray(F.ONE))
+    v = F.add_c(F.mul(y2, jnp.asarray(F.D_LIMBS)), jnp.asarray(F.ONE))
+    # candidate root of u/v: x = u·v^3·(u·v^7)^((p-5)/8)
+    v3 = F.mul(F.square(v), v)
+    v7 = F.mul(F.square(v3), v)
+    x = F.mul(F.mul(u, v3), F.pow22523(F.mul(u, v7)))
+    vx2 = F.mul(v, F.square(x))
+    root_ok = F.eq(vx2, u)
+    flip_ok = F.eq(vx2, F.neg(u))
+    x = jnp.where(
+        flip_ok[..., None] & ~root_ok[..., None],
+        F.mul(x, jnp.asarray(F.SQRT_M1_LIMBS)),
+        x,
+    )
+    valid = root_ok | flip_ok
+
+    x_canon = F.canonical(x)
+    x_is_zero = jnp.all(x_canon == 0, axis=-1)
+    # adjust sign: negate when parity differs
+    need_neg = (x_canon[..., 0] & 1) != sign
+    x = jnp.where(need_neg[..., None], F.neg(x), x)
+    # x = 0 with sign bit set has no representative (-0)
+    valid &= ~(x_is_zero & (sign == 1))
+
+    return Point(x, y, jnp.broadcast_to(jnp.asarray(F.ONE), y.shape), F.mul(x, y)), valid
+
+
+def scalar_mul_double(
+    s_bits: jnp.ndarray, h_bits: jnp.ndarray, a_neg: Point
+) -> Point:
+    """Joint double-scalar multiplication: returns s·B + h·(-A), batched.
+
+    s_bits, h_bits: (..., 256) int32 in {0,1}, little-endian bit order.
+    Runs one 256-iteration lax.scan (MSB first): Q = 2Q; Q += table[bits],
+    table = [Id, B, -A, B-A] selected branchlessly per element.
+    """
+    import jax
+
+    batch_shape = s_bits.shape[:-1]
+    idp = identity(batch_shape)
+    bp = base_point(batch_shape)
+    b_plus_an = point_add(bp, a_neg)
+
+    # scan over bits MSB->LSB: move bit axis to front, reversed
+    sb = jnp.moveaxis(s_bits[..., ::-1], -1, 0)  # (256, ...)
+    hb = jnp.moveaxis(h_bits[..., ::-1], -1, 0)
+
+    def step(q: Point, bits):
+        sbit, hbit = bits
+        q = point_double(q)
+        sel_s = sbit.astype(bool)
+        sel_h = hbit.astype(bool)
+        # table select: (sel_s, sel_h) -> Id / B / -A / B-A
+        t = point_select(
+            sel_s,
+            point_select(sel_h, b_plus_an, bp),
+            point_select(sel_h, a_neg, idp),
+        )
+        return point_add(q, t), None
+
+    q, _ = jax.lax.scan(step, idp, (sb, hb))
+    return q

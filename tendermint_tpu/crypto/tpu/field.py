@@ -1,0 +1,186 @@
+"""GF(2^255-19) arithmetic on vectors of radix-2^8 limbs, in int32.
+
+Representation: a field element is an int32 array of shape (..., 32), limb i
+holding (partially reduced) coefficient of 256^i, all limbs non-negative.
+The invariant maintained between operations is limbs < 2^10, which keeps the
+schoolbook product fold below 2^31:
+
+    conv ≤ 32·(2^10)² = 2^25,  fold ≤ (1+38)·2^25 < 2^31.
+
+`mul` returns limbs < 2^9 (three vectorized carry passes); `add` may be fed
+straight into `mul` once; `sub` carries once and returns limbs < 2^10.
+Canonicalization (exact byte form, for parity/equality/compression) uses a
+`lax.scan` along the limb axis — sequential in the 32 limbs, vectorized over
+the batch.
+
+Why radix 2^8 / int32 and not wider limbs: TPUs have no native 64-bit
+integer path (s64 is emulated), while int32 multiply-add runs on the VPU at
+full lane rate; 8-bit limbs also make byte-level I/O (keys, signatures) a
+zero-cost reinterpretation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LIMBS = 32
+P_INT = 2**255 - 19
+D_INT = (-121665 * pow(121666, P_INT - 2, P_INT)) % P_INT
+SQRT_M1_INT = pow(2, (P_INT - 1) // 4, P_INT)
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    """Python int -> canonical limb vector (numpy, for constants/host prep)."""
+    return np.frombuffer(int(v % P_INT).to_bytes(32, "little"), dtype=np.uint8).astype(
+        np.int32
+    )
+
+
+def limbs_to_int(a) -> int:
+    """Limb vector (possibly partially reduced) -> Python int mod p."""
+    a = np.asarray(a, dtype=np.int64)
+    return sum(int(x) << (8 * i) for i, x in enumerate(a)) % P_INT
+
+
+# constant limb vectors
+P_LIMBS = int_to_limbs(P_INT)
+D_LIMBS = int_to_limbs(D_INT)
+D2_LIMBS = int_to_limbs(2 * D_INT)
+SQRT_M1_LIMBS = int_to_limbs(SQRT_M1_INT)
+ONE = int_to_limbs(1)
+ZERO = np.zeros(LIMBS, dtype=np.int32)
+# 8p in limb form: every limb large enough to dominate a (<2^10)-bounded
+# subtrahend, used to keep subtraction non-negative.
+EIGHT_P = (8 * P_LIMBS).astype(np.int32)
+
+
+def _carry_pass(c: jnp.ndarray) -> jnp.ndarray:
+    """One vectorized carry: keep low byte, push high bits one limb up; the
+    carry out of limb 31 wraps to limb 0 multiplied by 38 (2^256 ≡ 38)."""
+    low = c & 0xFF
+    hi = c >> 8
+    hi_shift = jnp.concatenate([hi[..., 31:] * 38, hi[..., :31]], axis=-1)
+    return low + hi_shift
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply. Inputs: limbs s.t. max(a)·max(b)·32·39 < 2^31.
+    Output: limbs < 2^9."""
+    a, b = jnp.broadcast_arrays(a, b)
+    out_shape = a.shape[:-1] + (2 * LIMBS - 1,)
+    out = jnp.zeros(out_shape, jnp.int32)
+    for i in range(LIMBS):
+        out = out.at[..., i : i + LIMBS].add(a[..., i : i + 1] * b)
+    hi = jnp.pad(
+        out[..., LIMBS:], [(0, 0)] * (out.ndim - 1) + [(0, 1)], constant_values=0
+    )
+    c = out[..., :LIMBS] + 38 * hi
+    return _carry_pass(_carry_pass(_carry_pass(c)))
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Limb-wise add; result may be fed to one mul, but not chained adds
+    without a carry. Use `add_c` to re-establish the <2^10 bound."""
+    return a + b
+
+
+def add_c(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(a + b)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod p, non-negative limbs via +8p, then one carry pass.
+    Output limbs < 2^10."""
+    return _carry_pass(a + jnp.asarray(EIGHT_P) - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return _carry_pass(jnp.asarray(EIGHT_P) - a)
+
+
+def mul_scalar(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small constant (k ≤ 16; larger constants must go
+    through `mul` with a limb vector to respect the carry bounds)."""
+    return _carry_pass(_carry_pass(a * k))
+
+
+def pow2k(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a^(2^k) via k squarings (lax loop to keep the trace small)."""
+    return lax.fori_loop(0, k, lambda _, x: square(x), a)
+
+
+def pow22523(z: jnp.ndarray) -> jnp.ndarray:
+    """z^(2^252 - 3): the exponentiation used for inverse square roots in
+    decompression (classic ed25519 addition chain)."""
+    t0 = square(z)  # 2
+    t1 = square(square(t0))  # 8
+    t1 = mul(z, t1)  # 9
+    t0 = mul(t0, t1)  # 11
+    t0 = square(t0)  # 22
+    t0 = mul(t1, t0)  # 31 = 2^5 - 1
+    t1 = pow2k(t0, 5)
+    t0 = mul(t1, t0)  # 2^10 - 1
+    t1 = pow2k(t0, 10)
+    t1 = mul(t1, t0)  # 2^20 - 1
+    t2 = pow2k(t1, 20)
+    t1 = mul(t2, t1)  # 2^40 - 1
+    t1 = pow2k(t1, 10)
+    t0 = mul(t1, t0)  # 2^50 - 1
+    t1 = pow2k(t0, 50)
+    t1 = mul(t1, t0)  # 2^100 - 1
+    t2 = pow2k(t1, 100)
+    t1 = mul(t2, t1)  # 2^200 - 1
+    t1 = pow2k(t1, 50)
+    t0 = mul(t1, t0)  # 2^250 - 1
+    t0 = pow2k(t0, 2)  # 2^252 - 4
+    return mul(t0, z)  # 2^252 - 3
+
+
+def _scan_carry(c: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact sequential carry along the limb axis (batch-vectorized).
+    Returns (byte limbs, carry out of limb 31)."""
+    c_t = jnp.moveaxis(c, -1, 0)  # (32, ...)
+
+    def step(carry, limb):
+        v = limb + carry
+        return v >> 8, v & 0xFF
+
+    carry_out, limbs = lax.scan(step, jnp.zeros(c.shape[:-1], jnp.int32), c_t)
+    return jnp.moveaxis(limbs, 0, -1), carry_out
+
+
+def canonical(a: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to the canonical byte representation in [0, p)."""
+    v, carry = _scan_carry(a)
+    # fold 2^256-carries back in; after two folds the carry is exhausted
+    v, carry = _scan_carry(v.at[..., 0].add(carry * 38))
+    v, carry = _scan_carry(v.at[..., 0].add(carry * 38))
+    # v < 2^256 now; subtract p (conditionally) twice via the +19 trick:
+    # v >= p  iff  v + 19 >= 2^255
+    for _ in range(2):
+        w, wcarry = _scan_carry(v.at[..., 0].add(19))
+        ge = (wcarry > 0) | (w[..., 31] >= 0x80)
+        w = w.at[..., 31].set(w[..., 31] & 0x7F)  # w - 2^255 == v - p
+        v = jnp.where(ge[..., None], w, v)
+    return v
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    """a ≡ 0 (mod p), elementwise over the batch. Returns bool (...,)."""
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(sub(a, b))
+
+
+def parity(a: jnp.ndarray) -> jnp.ndarray:
+    """Low bit of the canonical representation."""
+    return canonical(a)[..., 0] & 1

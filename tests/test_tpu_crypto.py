@@ -1,0 +1,196 @@
+"""Differential tests: JAX field/curve/verify kernel vs the pure-Python
+ed25519 oracle (crypto/ed25519_math.py). Runs on the CPU backend in CI; the
+same code compiles for TPU unchanged."""
+
+import secrets
+
+import numpy as np
+import pytest
+
+from tendermint_tpu.crypto import ed25519, ed25519_math as em
+from tendermint_tpu.crypto.tpu import field as F
+from tendermint_tpu.crypto.tpu import curve as C
+from tendermint_tpu.crypto.tpu.verify import prepare_batch, verify_batch
+
+import jax.numpy as jnp
+
+
+def rand_fe(n=4):
+    return [secrets.randbelow(F.P_INT) for _ in range(n)]
+
+
+def to_batch(vals):
+    return jnp.asarray(np.stack([F.int_to_limbs(v) for v in vals]))
+
+
+def test_field_mul_matches_bigint():
+    a_vals, b_vals = rand_fe(8), rand_fe(8)
+    out = F.mul(to_batch(a_vals), to_batch(b_vals))
+    out = np.asarray(out)
+    for i in range(8):
+        assert F.limbs_to_int(out[i]) == a_vals[i] * b_vals[i] % F.P_INT
+        assert out[i].max() < 2**9  # carry bound invariant
+
+
+def test_field_chained_ops():
+    a_vals, b_vals = rand_fe(4), rand_fe(4)
+    a, b = to_batch(a_vals), to_batch(b_vals)
+    # (a-b)*(a+b) == a^2 - b^2
+    lhs = F.mul(F.sub(a, b), F.add(a, b))
+    rhs = F.sub(F.square(a), F.square(b))
+    assert bool(F.eq(lhs, rhs).all())
+    for i in range(4):
+        expect = (a_vals[i] ** 2 - b_vals[i] ** 2) % F.P_INT
+        assert F.limbs_to_int(np.asarray(lhs)[i]) == expect
+
+
+def test_field_canonical():
+    vals = [0, 1, 19, F.P_INT - 1, F.P_INT, F.P_INT + 5, 2**255 - 1]
+    # feed NON-canonical limb forms: add p again via limb arithmetic
+    arrs = []
+    for v in vals:
+        limbs = F.int_to_limbs(v % F.P_INT).astype(np.int32)
+        arrs.append(limbs + F.P_LIMBS)  # limbs ≤ 510, value v + p
+    out = np.asarray(F.canonical(jnp.asarray(np.stack(arrs))))
+    for i, v in enumerate(vals):
+        assert F.limbs_to_int(out[i]) == v % F.P_INT
+        assert (out[i] == F.int_to_limbs(v % F.P_INT)).all()
+
+
+def test_field_is_zero_and_parity():
+    a = to_batch([0, 1, F.P_INT - 1, 2])
+    z = np.asarray(F.is_zero(a))
+    assert list(z) == [True, False, False, False]
+    par = np.asarray(F.parity(a))
+    assert list(par) == [0, 1, (F.P_INT - 1) & 1, 0]
+
+
+def test_pow22523():
+    vals = rand_fe(2)
+    out = np.asarray(F.pow22523(to_batch(vals)))
+    e = (F.P_INT - 5) // 8  # 2^252 - 3
+    for i, v in enumerate(vals):
+        assert F.limbs_to_int(out[i]) == pow(v, e, F.P_INT)
+
+
+def _point_to_ints(p, i):
+    x = F.limbs_to_int(np.asarray(p.x)[i])
+    y = F.limbs_to_int(np.asarray(p.y)[i])
+    z = F.limbs_to_int(np.asarray(p.z)[i])
+    zi = pow(z, F.P_INT - 2, F.P_INT)
+    return x * zi % F.P_INT, y * zi % F.P_INT
+
+
+def test_point_add_double_vs_oracle():
+    ks = [1, 2, 5, 12345]
+    pts = [em.BASE.scalar_mul(k) for k in ks]
+    xs = to_batch([p.X * pow(p.Z, F.P_INT - 2, F.P_INT) % F.P_INT for p in pts])
+    ys = to_batch([p.Y * pow(p.Z, F.P_INT - 2, F.P_INT) % F.P_INT for p in pts])
+    P = C.Point(xs, ys, jnp.broadcast_to(jnp.asarray(F.ONE), xs.shape), F.mul(xs, ys))
+    D = C.point_double(P)
+    S = C.point_add(P, C.base_point((4,)))
+    for i, k in enumerate(ks):
+        expect_d = em.BASE.scalar_mul(2 * k)
+        ex, ey = _point_to_ints(D, i)
+        assert (ex, ey) == (
+            expect_d.X * pow(expect_d.Z, F.P_INT - 2, F.P_INT) % F.P_INT,
+            expect_d.Y * pow(expect_d.Z, F.P_INT - 2, F.P_INT) % F.P_INT,
+        )
+        expect_s = em.BASE.scalar_mul(k + 1)
+        sx, sy = _point_to_ints(S, i)
+        zi = pow(expect_s.Z, F.P_INT - 2, F.P_INT)
+        assert (sx, sy) == (expect_s.X * zi % F.P_INT, expect_s.Y * zi % F.P_INT)
+
+
+def test_point_add_identity_complete():
+    idp = C.identity((2,))
+    bp = C.base_point((2,))
+    out = C.point_add(idp, bp)
+    assert bool(C.point_eq(out, bp).all())
+    assert bool(C.is_identity(C.point_add(idp, idp)).all())
+
+
+def test_decompress_vs_oracle():
+    ks = [1, 2, 7, 99, 123456789]
+    encs = [em.BASE.scalar_mul(k).compress() for k in ks]
+    # add one invalid encoding (y with no square root) and the identity
+    encs.append((1).to_bytes(32, "little"))  # identity
+    bad = bytearray(32)
+    bad[0] = 2  # y=2 — happens to be off-curve for ed25519
+    encs.append(bytes(bad))
+    arr = jnp.asarray(
+        np.stack([np.frombuffer(e, np.uint8).astype(np.int32) for e in encs])
+    )
+    pt, valid = C.decompress(arr)
+    valid = np.asarray(valid)
+    for i, k in enumerate(ks):
+        assert valid[i]
+        ex, ey = _point_to_ints(pt, i)
+        oracle = em.Point.decompress(encs[i])
+        zi = pow(oracle.Z, F.P_INT - 2, F.P_INT)
+        assert (ex, ey) == (oracle.X * zi % F.P_INT, oracle.Y * zi % F.P_INT)
+    assert valid[len(ks)]  # identity decompresses
+    oracle_bad = em.Point.decompress(encs[-1])
+    assert bool(valid[-1]) == (oracle_bad is not None)
+
+
+def test_verify_batch_valid_and_invalid():
+    n = 16
+    keys = [ed25519.Ed25519PrivKey.generate() for _ in range(n)]
+    msgs = [secrets.token_bytes(40 + i) for i in range(n)]
+    items = []
+    expected = []
+    for i, (k, m) in enumerate(zip(keys, msgs)):
+        sig = k.sign(m)
+        if i % 5 == 1:
+            sig = sig[:-1] + bytes([sig[-1] ^ 1])  # corrupt s
+            expected.append(False)
+        elif i % 5 == 3:
+            m = m + b"tampered"
+            expected.append(False)
+        else:
+            expected.append(True)
+        items.append((k.pub_key().bytes(), m, sig))
+    bitmap = verify_batch(items)
+    assert list(bitmap) == expected
+
+
+def test_verify_batch_noncanonical_s_rejected():
+    k = ed25519.Ed25519PrivKey.generate()
+    m = b"msg"
+    sig = bytearray(k.sign(m))
+    s = int.from_bytes(sig[32:], "little")
+    sig[32:] = (s + em.L).to_bytes(32, "little")
+    bitmap = verify_batch([(k.pub_key().bytes(), m, bytes(sig))])
+    assert not bitmap[0]
+
+
+def test_verify_batch_zip215_edge_cases():
+    # identity pubkey (small-order) with s=0, R=identity: 0*B == R + k*A holds
+    # for any k iff R and k*A cancel; with A=R=identity and s=0 the cofactored
+    # equation holds — ZIP-215 accepts.
+    ident = (1).to_bytes(32, "little")
+    sig = ident + (0).to_bytes(32, "little")
+    bitmap = verify_batch([(ident, b"anything", sig)])
+    assert em.verify_zip215(ident, b"anything", sig)
+    assert bitmap[0]
+
+
+def test_tpu_batch_verifier_interface():
+    from tendermint_tpu.crypto.tpu.verify import TPUBatchVerifier
+    from tendermint_tpu.crypto import secp256k1
+
+    bv = TPUBatchVerifier()
+    eds = [ed25519.Ed25519PrivKey.generate() for _ in range(3)]
+    sec = secp256k1.Secp256k1PrivKey.generate()
+    for i, k in enumerate(eds):
+        m = f"m{i}".encode()
+        bv.add(k.pub_key(), m, k.sign(m))
+    bv.add(sec.pub_key(), b"sm", sec.sign(b"sm"))
+    ok, bits = bv.verify()
+    assert ok and bits == [True] * 4
+
+    bv2 = TPUBatchVerifier()
+    bv2.add(eds[0].pub_key(), b"a", eds[0].sign(b"b"))
+    ok, bits = bv2.verify()
+    assert not ok and bits == [False]
