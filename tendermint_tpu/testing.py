@@ -1,0 +1,91 @@
+"""Test/bench fixtures: deterministic validator sets, signed commits, and
+chains — the analog of the reference's internal test factories. Used by the
+unit tests and bench.py; not part of the public API surface."""
+
+from __future__ import annotations
+
+import hashlib
+
+from .crypto import ed25519
+from .crypto.hashes import sha256
+from .types.block import BlockID, Commit, CommitSig, PartSetHeader
+from .types.keys import SignedMsgType
+from .types.validator_set import Validator, ValidatorSet
+from .types.vote import Vote
+from .types.canonical import vote_sign_bytes
+
+
+def det_priv_keys(n: int, seed: bytes = b"tmtpu-test") -> list[ed25519.Ed25519PrivKey]:
+    return [
+        ed25519.Ed25519PrivKey(hashlib.sha256(seed + i.to_bytes(4, "big")).digest())
+        for i in range(n)
+    ]
+
+
+def make_validator_set(
+    n: int, power: int = 10, seed: bytes = b"tmtpu-test"
+) -> tuple[ValidatorSet, dict[bytes, ed25519.Ed25519PrivKey]]:
+    keys = det_priv_keys(n, seed)
+    vals = ValidatorSet([Validator(k.pub_key(), power) for k in keys])
+    by_addr = {k.pub_key().address(): k for k in keys}
+    return vals, by_addr
+
+
+def make_block_id(tag: bytes = b"blk") -> BlockID:
+    return BlockID(sha256(tag), PartSetHeader(1, sha256(b"parts" + tag)))
+
+
+def make_commit(
+    chain_id: str,
+    height: int,
+    round_: int,
+    block_id: BlockID,
+    vals: ValidatorSet,
+    keys_by_addr: dict,
+    *,
+    nil_indices: frozenset[int] = frozenset(),
+    absent_indices: frozenset[int] = frozenset(),
+    timestamp_ns: int = 1_700_000_000_000_000_000,
+) -> Commit:
+    """Build a fully-signed commit over `block_id` by the validator set."""
+    from .types.block import NIL_BLOCK_ID
+
+    sigs = []
+    for i, val in enumerate(vals.validators):
+        if i in absent_indices:
+            sigs.append(CommitSig.absent())
+            continue
+        ts = timestamp_ns + i
+        vote_bid = NIL_BLOCK_ID if i in nil_indices else block_id
+        sb = vote_sign_bytes(
+            chain_id, SignedMsgType.PRECOMMIT, height, round_, vote_bid, ts
+        )
+        sig = keys_by_addr[val.address].sign(sb)
+        if i in nil_indices:
+            sigs.append(CommitSig.for_nil(val.address, ts, sig))
+        else:
+            sigs.append(CommitSig.for_block(val.address, ts, sig))
+    return Commit(height, round_, block_id, tuple(sigs))
+
+
+def make_vote(
+    chain_id: str,
+    key: ed25519.Ed25519PrivKey,
+    index: int,
+    height: int,
+    round_: int,
+    type_: SignedMsgType,
+    block_id: BlockID,
+    timestamp_ns: int = 1_700_000_000_000_000_000,
+) -> Vote:
+    sb = vote_sign_bytes(chain_id, type_, height, round_, block_id, timestamp_ns)
+    return Vote(
+        type=type_,
+        height=height,
+        round=round_,
+        block_id=block_id,
+        timestamp_ns=timestamp_ns,
+        validator_address=key.pub_key().address(),
+        validator_index=index,
+        signature=key.sign(sb),
+    )

@@ -1,0 +1,14 @@
+"""Domain types: blocks, votes, commits, validator sets, validation.
+
+The consensus-critical surface mirrors the reference's types package
+(reference types/ — Block/Header/Commit in block.go, Vote in vote.go,
+ValidatorSet in validator_set.go, VerifyCommit* in validation.go) with
+byte-deterministic canonical encodings produced by libs/protoenc.
+"""
+
+from .keys import SignedMsgType, BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL
+from .block import BlockID, PartSetHeader, CommitSig, Commit, Header, Block
+from .vote import Vote
+from .validator_set import Validator, ValidatorSet
+from .vote_set import VoteSet
+from . import validation

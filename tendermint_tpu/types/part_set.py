@@ -1,0 +1,91 @@
+"""PartSet — blocks split into 64 KB merkle-proved parts for gossip
+(reference types/part_set.go). A proposer splits the encoded block; peers
+reassemble parts in any order, each carrying an inclusion proof against the
+PartSetHeader hash."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..crypto import merkle
+from ..libs import protoenc as pe
+from ..libs.bits import BitArray
+from .block import PartSetHeader
+from .keys import BLOCK_PART_SIZE
+
+
+@dataclass(frozen=True)
+class Part:
+    index: int
+    bytes_: bytes
+    proof: merkle.Proof
+
+    def encode(self) -> bytes:
+        out = pe.varint_field(1, self.index + 1)
+        out += pe.bytes_field(2, self.bytes_)
+        out += pe.message_field(3, self.proof.encode())
+        return out
+
+    @classmethod
+    def decode(cls, data: bytes) -> "Part":
+        r = pe.Reader(data)
+        index, bytes_, proof = 0, b"", merkle.Proof(0, 0, b"", [])
+        while not r.eof():
+            f, wt = r.read_tag()
+            if f == 1:
+                index = r.read_uvarint() - 1
+            elif f == 2:
+                bytes_ = r.read_bytes()
+            elif f == 3:
+                proof = merkle.Proof.decode(r.read_bytes())
+            else:
+                r.skip(wt)
+        return cls(index, bytes_, proof)
+
+
+class PartSet:
+    @classmethod
+    def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
+        chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)] or [b""]
+        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        ps = cls(PartSetHeader(total=len(chunks), hash=root))
+        for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
+            ps.add_part(Part(i, chunk, proof))
+        return ps
+
+    def __init__(self, header: PartSetHeader):
+        self.header = header
+        self.parts: list[Part | None] = [None] * header.total
+        self.parts_bit_array = BitArray(header.total)
+        self.count = 0
+        self.byte_size = 0
+
+    def add_part(self, part: Part) -> bool:
+        """Verify the part's proof and slot it in. Returns False for
+        duplicates; raises on invalid proofs."""
+        if not 0 <= part.index < self.header.total:
+            raise ValueError(f"part index {part.index} out of range")
+        if self.parts[part.index] is not None:
+            return False
+        if part.proof.index != part.index or part.proof.total != self.header.total:
+            raise ValueError("part proof position mismatch")
+        if not part.proof.verify(self.header.hash, part.bytes_):
+            raise ValueError("invalid part proof")
+        self.parts[part.index] = part
+        self.parts_bit_array.set(part.index, True)
+        self.count += 1
+        self.byte_size += len(part.bytes_)
+        return True
+
+    def get_part(self, idx: int) -> Part | None:
+        if 0 <= idx < len(self.parts):
+            return self.parts[idx]
+        return None
+
+    def is_complete(self) -> bool:
+        return self.count == self.header.total
+
+    def assemble(self) -> bytes:
+        if not self.is_complete():
+            raise ValueError("incomplete part set")
+        return b"".join(p.bytes_ for p in self.parts)

@@ -1,0 +1,214 @@
+"""Commit verification — THE hot entry point of the framework (analog of
+reference types/validation.go:25-265).
+
+Three variants, all funneling every signature in a Commit into one
+BatchVerifier call (one TPU kernel launch):
+
+  verify_commit              — full validation: every non-absent signature
+                               must verify (commit AND nil votes); tallied
+                               power counts only votes for the block.
+  verify_commit_light        — only signatures for the committed block are
+                               verified; returns as soon as +2/3 is reached.
+  verify_commit_light_trusting — light-client skipping verification: looks
+                               validators up by address in the *trusted* set
+                               and requires `trust_level` (default 1/3) of
+                               its total power.
+
+Batch verification engages when the key type supports it and there are at
+least BATCH_VERIFY_THRESHOLD signatures (reference types/validation.go:12);
+otherwise single verification. On batch failure the per-signature bitmap
+pinpoints the offending signature for the error message.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from fractions import Fraction
+
+from ..crypto import batch as crypto_batch
+from .block import BlockID, Commit
+from .validator_set import ValidatorSet
+
+BATCH_VERIFY_THRESHOLD = 2
+
+
+class InvalidCommitError(ValueError):
+    pass
+
+
+def _basic_commit_checks(
+    vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
+) -> None:
+    if commit.height != height:
+        raise InvalidCommitError(f"commit height {commit.height} != {height}")
+    if commit.block_id != block_id:
+        raise InvalidCommitError("commit is for a different block")
+    if len(vals) != commit.size():
+        raise InvalidCommitError(
+            f"validator set size {len(vals)} != commit size {commit.size()}"
+        )
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    return commit.size() >= BATCH_VERIFY_THRESHOLD and all(
+        crypto_batch.supports_batch_verifier(v.pub_key) for v in vals.validators
+    )
+
+
+def verify_commit(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> None:
+    """Full commit verification (reference types/validation.go:25).
+    Raises InvalidCommitError on failure."""
+    _basic_commit_checks(vals, block_id, height, commit)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    _verify(
+        chain_id,
+        vals,
+        commit,
+        voting_power_needed,
+        count_all_signatures=True,
+        lookup_by_index=True,
+    )
+
+
+def verify_commit_light(
+    chain_id: str,
+    vals: ValidatorSet,
+    block_id: BlockID,
+    height: int,
+    commit: Commit,
+) -> None:
+    """Verify only the signatures for the committed block, stopping at +2/3
+    (reference types/validation.go:59) — the block-sync/light-client path."""
+    _basic_commit_checks(vals, block_id, height, commit)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    _verify(
+        chain_id,
+        vals,
+        commit,
+        voting_power_needed,
+        count_all_signatures=False,
+        lookup_by_index=True,
+    )
+
+
+def verify_commit_light_trusting(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    trust_level: Fraction = Fraction(1, 3),
+) -> None:
+    """Light-client skipping verification against a *trusted* validator set
+    (reference types/validation.go:94): validators are matched by address
+    (the untrusted set may have rotated), and `trust_level` of the trusted
+    power must have signed."""
+    if trust_level.numerator * 3 < trust_level.denominator or trust_level > 1:
+        raise ValueError("trust level must be in [1/3, 1]")
+    total = vals.total_voting_power()
+    voting_power_needed = total * trust_level.numerator // trust_level.denominator
+    _verify(
+        chain_id,
+        vals,
+        commit,
+        voting_power_needed,
+        count_all_signatures=False,
+        lookup_by_index=False,
+    )
+
+
+def _verify(
+    chain_id: str,
+    vals: ValidatorSet,
+    commit: Commit,
+    voting_power_needed: int,
+    count_all_signatures: bool,
+    lookup_by_index: bool,
+) -> None:
+    if _should_batch_verify(vals, commit):
+        _verify_batch(
+            chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+        )
+    else:
+        _verify_single(
+            chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+        )
+
+
+def _iter_entries(vals: ValidatorSet, commit: Commit, lookup_by_index: bool):
+    """Yield (idx, commit_sig, validator) for signatures that participate.
+    Absent sigs never participate; with address lookup (trusting mode),
+    unknown validators are skipped and double-signing addresses rejected."""
+    seen: set[bytes] = set()
+    for idx, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            continue
+        if lookup_by_index:
+            val = vals.get_by_index(idx)
+            if val is None:
+                raise InvalidCommitError(f"no validator at index {idx}")
+        else:
+            _, val = vals.get_by_address(cs.validator_address)
+            if val is None:
+                continue
+            if cs.validator_address in seen:
+                raise InvalidCommitError("double vote from same address")
+            seen.add(cs.validator_address)
+        yield idx, cs, val
+
+
+def _verify_batch(
+    chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+) -> None:
+    bv = crypto_batch.create_batch_verifier(vals.validators[0].pub_key)
+    tallied = 0
+    added = 0
+    entries = []
+    for idx, cs, val in _iter_entries(vals, commit, lookup_by_index):
+        if not count_all_signatures and not cs.is_commit():
+            continue
+        bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+        added += 1
+        entries.append((idx, cs, val))
+        if cs.is_commit():
+            tallied += val.voting_power
+        # early cut-off: beyond +2/3 no further signatures are needed
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+    if tallied <= voting_power_needed:
+        raise InvalidCommitError(
+            f"insufficient voting power: got {tallied}, need > {voting_power_needed}"
+        )
+    if added == 0:
+        raise InvalidCommitError("no signatures to verify")
+    ok, bitmap = bv.verify()
+    if not ok:
+        for (idx, _, _), good in zip(entries, bitmap):
+            if not good:
+                raise InvalidCommitError(f"invalid signature at index {idx}")
+        raise InvalidCommitError("batch verification failed")
+
+
+def _verify_single(
+    chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+) -> None:
+    tallied = 0
+    for idx, cs, val in _iter_entries(vals, commit, lookup_by_index):
+        if not count_all_signatures and not cs.is_commit():
+            continue
+        if not val.pub_key.verify_signature(
+            commit.vote_sign_bytes(chain_id, idx), cs.signature
+        ):
+            raise InvalidCommitError(f"invalid signature at index {idx}")
+        if cs.is_commit():
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+    if tallied <= voting_power_needed:
+        raise InvalidCommitError(
+            f"insufficient voting power: got {tallied}, need > {voting_power_needed}"
+        )

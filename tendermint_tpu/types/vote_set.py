@@ -1,0 +1,147 @@
+"""VoteSet — collects votes of one (height, round, type), tracks the 2/3
+tally, detects conflicting votes (reference types/vote_set.go).
+
+A vote set accepts at most one vote per validator; a second, different vote
+from the same validator is rejected and surfaced as a conflict pair for the
+evidence pool. `two_thirds_majority()` returns the BlockID once >2/3 of the
+voting power has voted for it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..libs.bits import BitArray
+from .block import BlockID
+from .keys import SignedMsgType
+from .validator_set import ValidatorSet
+from .vote import Vote
+
+
+class VoteSetError(ValueError):
+    pass
+
+
+@dataclass
+class ConflictingVoteError(Exception):
+    existing: Vote
+    new: Vote
+
+
+class VoteSet:
+    def __init__(
+        self,
+        chain_id: str,
+        height: int,
+        round_: int,
+        type_: SignedMsgType,
+        val_set: ValidatorSet,
+    ):
+        self.chain_id = chain_id
+        self.height = height
+        self.round = round_
+        self.type = type_
+        self.val_set = val_set
+        self.votes: list[Vote | None] = [None] * len(val_set)
+        self.votes_bit_array = BitArray(len(val_set))
+        self.sum = 0
+        self._by_block: dict[bytes, int] = {}  # block key -> tallied power
+        self._block_votes: dict[bytes, BitArray] = {}
+        self.maj23: BlockID | None = None
+
+    def size(self) -> int:
+        return len(self.val_set)
+
+    def add_vote(self, vote: Vote) -> bool:
+        """Validate + add a vote. Returns True if added; raises on invalid
+        votes; raises ConflictingVoteError on an equivocation (the caller
+        turns it into DuplicateVoteEvidence)."""
+        if vote is None:
+            raise VoteSetError("nil vote")
+        if (
+            vote.height != self.height
+            or vote.round != self.round
+            or vote.type != self.type
+        ):
+            raise VoteSetError(
+                f"vote {vote.height}/{vote.round}/{vote.type} does not match "
+                f"set {self.height}/{self.round}/{self.type}"
+            )
+        idx = vote.validator_index
+        val = self.val_set.get_by_index(idx)
+        if val is None:
+            raise VoteSetError(f"no validator at index {idx}")
+        if val.address != vote.validator_address:
+            raise VoteSetError("validator address does not match index")
+
+        existing = self.votes[idx]
+        if existing is not None:
+            if existing.block_id == vote.block_id:
+                return False  # duplicate, not an error
+            raise ConflictingVoteError(existing, vote)
+
+        if not vote.verify(self.chain_id, val.pub_key):
+            raise VoteSetError(f"invalid signature from validator {idx}")
+
+        self.votes[idx] = vote
+        self.votes_bit_array.set(idx, True)
+        self.sum += val.voting_power
+        key = vote.block_id.key()
+        self._by_block[key] = self._by_block.get(key, 0) + val.voting_power
+        ba = self._block_votes.setdefault(key, BitArray(len(self.val_set)))
+        ba.set(idx, True)
+        total = self.val_set.total_voting_power()
+        if self.maj23 is None and self._by_block[key] * 3 > total * 2:
+            self.maj23 = vote.block_id
+        return True
+
+    def get_vote(self, idx: int) -> Vote | None:
+        if 0 <= idx < len(self.votes):
+            return self.votes[idx]
+        return None
+
+    def two_thirds_majority(self) -> BlockID | None:
+        return self.maj23
+
+    def has_two_thirds_majority(self) -> bool:
+        return self.maj23 is not None
+
+    def has_two_thirds_any(self) -> bool:
+        return self.sum * 3 > self.val_set.total_voting_power() * 2
+
+    def has_all(self) -> bool:
+        return self.sum == self.val_set.total_voting_power()
+
+    def bit_array_by_block_id(self, block_id: BlockID) -> BitArray | None:
+        ba = self._block_votes.get(block_id.key())
+        return ba.copy() if ba is not None else None
+
+    def make_commit(self) -> "Commit":
+        """Materialize a Commit once a block has +2/3 precommits
+        (reference types/vote_set.go MakeCommit)."""
+        from .block import Commit, CommitSig
+
+        if self.type != SignedMsgType.PRECOMMIT:
+            raise VoteSetError("commit requires precommits")
+        if self.maj23 is None or self.maj23.is_nil():
+            raise VoteSetError("no +2/3 majority for a block")
+        sigs = []
+        for i, vote in enumerate(self.votes):
+            if vote is None:
+                sigs.append(CommitSig.absent())
+            elif vote.block_id == self.maj23:
+                sigs.append(
+                    CommitSig.for_block(
+                        vote.validator_address, vote.timestamp_ns, vote.signature
+                    )
+                )
+            elif vote.is_nil():
+                sigs.append(
+                    CommitSig.for_nil(
+                        vote.validator_address, vote.timestamp_ns, vote.signature
+                    )
+                )
+            else:
+                # vote for a different block: recorded as absent in the commit
+                sigs.append(CommitSig.absent())
+        return Commit(self.height, self.round, self.maj23, tuple(sigs))

@@ -1,0 +1,369 @@
+"""Domain-type tests: canonical sign-bytes, commit verification variants
+(pinning the 2/3+ and edge-case semantics, mirroring the reference's
+validation_test strategy), proposer rotation, vote sets, part sets."""
+
+import os
+from fractions import Fraction
+
+import pytest
+
+os.environ.setdefault("TMTPU_DISABLE_TPU", "1")  # types tests use CPU verify
+
+from tendermint_tpu import testing as tt
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.libs import protoenc as pe
+from tendermint_tpu.types import validation
+from tendermint_tpu.types.block import (
+    Block,
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    NIL_BLOCK_ID,
+    PartSetHeader,
+    txs_hash,
+)
+from tendermint_tpu.types.canonical import vote_sign_bytes
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence, decode_evidence
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+from tendermint_tpu.types.keys import SignedMsgType
+from tendermint_tpu.types.params import ConsensusParams
+from tendermint_tpu.types.part_set import PartSet
+from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+from tendermint_tpu.types.vote import Proposal, Vote
+from tendermint_tpu.types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
+
+CHAIN = "test-chain"
+
+
+def test_sign_bytes_deterministic_and_distinct():
+    bid = tt.make_block_id()
+    a = vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 5, 0, bid, 1000)
+    b = vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 5, 0, bid, 1000)
+    assert a == b
+    # any field change must change the bytes
+    variants = [
+        vote_sign_bytes("other", SignedMsgType.PRECOMMIT, 5, 0, bid, 1000),
+        vote_sign_bytes(CHAIN, SignedMsgType.PREVOTE, 5, 0, bid, 1000),
+        vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 6, 0, bid, 1000),
+        vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 5, 1, bid, 1000),
+        vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 5, 0, None, 1000),
+        vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 5, 0, bid, 1001),
+    ]
+    assert len({a, *variants}) == len(variants) + 1
+
+
+def test_sign_bytes_fixed_width_height():
+    # sfixed64 height: heights 1 and 256 produce equal-length encodings
+    bid = tt.make_block_id()
+    a = vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 1, 0, bid, 1000)
+    b = vote_sign_bytes(CHAIN, SignedMsgType.PRECOMMIT, 256, 0, bid, 1000)
+    assert len(a) == len(b)
+
+
+def test_commit_roundtrip():
+    vals, keys = tt.make_validator_set(4)
+    bid = tt.make_block_id()
+    commit = tt.make_commit(CHAIN, 3, 1, bid, vals, keys, nil_indices=frozenset([2]))
+    decoded = Commit.decode(commit.encode())
+    assert decoded == commit
+    assert decoded.hash() == commit.hash()
+
+
+def test_verify_commit_all_good():
+    vals, keys = tt.make_validator_set(10)
+    bid = tt.make_block_id()
+    commit = tt.make_commit(CHAIN, 3, 0, bid, vals, keys)
+    validation.verify_commit(CHAIN, vals, bid, 3, commit)
+    validation.verify_commit_light(CHAIN, vals, bid, 3, commit)
+    validation.verify_commit_light_trusting(CHAIN, vals, commit)
+
+
+def test_verify_commit_exactly_two_thirds_fails():
+    # 10 validators, power 10 each: need > 66; 7 commits = 70 ok, 6 = 60 fails
+    vals, keys = tt.make_validator_set(10)
+    bid = tt.make_block_id()
+    commit_ok = tt.make_commit(
+        CHAIN, 3, 0, bid, vals, keys, nil_indices=frozenset([7, 8, 9])
+    )
+    validation.verify_commit(CHAIN, vals, bid, 3, commit_ok)
+    commit_bad = tt.make_commit(
+        CHAIN, 3, 0, bid, vals, keys, nil_indices=frozenset([6, 7, 8, 9])
+    )
+    with pytest.raises(validation.InvalidCommitError, match="insufficient"):
+        validation.verify_commit(CHAIN, vals, bid, 3, commit_bad)
+
+
+def test_verify_commit_bad_signature_detected():
+    vals, keys = tt.make_validator_set(6)
+    bid = tt.make_block_id()
+    commit = tt.make_commit(CHAIN, 3, 0, bid, vals, keys)
+    sigs = list(commit.signatures)
+    bad = sigs[2]
+    sigs[2] = CommitSig.for_block(
+        bad.validator_address, bad.timestamp_ns, bad.signature[:-1] + b"\x00"
+    )
+    commit_bad = Commit(3, 0, bid, tuple(sigs))
+    with pytest.raises(validation.InvalidCommitError, match="index 2"):
+        validation.verify_commit(CHAIN, vals, bid, 3, commit_bad)
+
+
+def test_verify_commit_nil_vote_with_bad_sig_fails_full_but_not_light():
+    # nil votes are verified by verify_commit (count_all) but skipped by light
+    vals, keys = tt.make_validator_set(10)
+    bid = tt.make_block_id()
+    commit = tt.make_commit(CHAIN, 3, 0, bid, vals, keys, nil_indices=frozenset([9]))
+    sigs = list(commit.signatures)
+    nil_sig = sigs[9]
+    sigs[9] = CommitSig.for_nil(
+        nil_sig.validator_address, nil_sig.timestamp_ns, b"\x01" * 64
+    )
+    commit_bad = Commit(3, 0, bid, tuple(sigs))
+    with pytest.raises(validation.InvalidCommitError):
+        validation.verify_commit(CHAIN, vals, bid, 3, commit_bad)
+    validation.verify_commit_light(CHAIN, vals, bid, 3, commit_bad)
+
+
+def test_verify_commit_mismatches():
+    vals, keys = tt.make_validator_set(4)
+    bid = tt.make_block_id()
+    commit = tt.make_commit(CHAIN, 3, 0, bid, vals, keys)
+    with pytest.raises(validation.InvalidCommitError, match="height"):
+        validation.verify_commit(CHAIN, vals, bid, 4, commit)
+    with pytest.raises(validation.InvalidCommitError, match="different block"):
+        validation.verify_commit(CHAIN, vals, tt.make_block_id(b"other"), 3, commit)
+    smaller, _ = tt.make_validator_set(3)
+    with pytest.raises(validation.InvalidCommitError, match="size"):
+        validation.verify_commit(CHAIN, smaller, bid, 3, commit)
+
+
+def test_verify_commit_light_trusting_rotated_set():
+    # trusting: new set shares 2 of 4 validators; by-address lookup
+    vals, keys = tt.make_validator_set(4, seed=b"setA")
+    bid = tt.make_block_id()
+    commit = tt.make_commit(CHAIN, 3, 0, bid, vals, keys)
+    vals_b, keys_b = tt.make_validator_set(4, seed=b"setB")
+    # trusted set = 2 from A + 2 from B: 2/4 of trusted power signed = 50% > 1/3
+    mixed = ValidatorSet(
+        [Validator(v.pub_key, v.voting_power) for v in vals.validators[:2]]
+        + [Validator(v.pub_key, v.voting_power) for v in vals_b.validators[:2]]
+    )
+    validation.verify_commit_light_trusting(CHAIN, mixed, commit)
+    # with trust level 2/3, 50% is not enough
+    with pytest.raises(validation.InvalidCommitError):
+        validation.verify_commit_light_trusting(
+            CHAIN, mixed, commit, trust_level=Fraction(2, 3)
+        )
+
+
+def test_verify_commit_single_matches_batch():
+    vals, keys = tt.make_validator_set(8)
+    bid = tt.make_block_id()
+    commit = tt.make_commit(CHAIN, 2, 0, bid, vals, keys)
+    validation._verify_single(CHAIN, vals, commit, vals.total_voting_power() * 2 // 3, True, True)
+    validation._verify_batch(CHAIN, vals, commit, vals.total_voting_power() * 2 // 3, True, True)
+
+
+def test_proposer_rotation_fair():
+    # equal powers: round-robin; each validator proposes once per n rounds
+    vals, _ = tt.make_validator_set(5)
+    seen = []
+    vs = vals.copy()
+    for _ in range(5):
+        seen.append(vs.get_proposer().address)
+        vs.increment_proposer_priority(1)
+    assert len(set(seen)) == 5
+
+
+def test_proposer_rotation_weighted():
+    keys = tt.det_priv_keys(3, b"weighted")
+    vals = ValidatorSet(
+        [
+            Validator(keys[0].pub_key(), 1),
+            Validator(keys[1].pub_key(), 2),
+            Validator(keys[2].pub_key(), 7),
+        ]
+    )
+    counts = {}
+    vs = vals.copy()
+    for _ in range(100):
+        addr = vs.get_proposer().address
+        counts[addr] = counts.get(addr, 0) + 1
+        vs.increment_proposer_priority(1)
+    assert counts[keys[2].pub_key().address()] == 70
+    assert counts[keys[1].pub_key().address()] == 20
+    assert counts[keys[0].pub_key().address()] == 10
+
+
+def test_validator_set_update_and_hash():
+    vals, _ = tt.make_validator_set(4)
+    h0 = vals.hash()
+    new_key = ed25519.Ed25519PrivKey.generate()
+    vals2 = vals.copy()
+    vals2.update_with_change_set([Validator(new_key.pub_key(), 5)])
+    assert len(vals2) == 5
+    assert vals2.hash() != h0
+    # new validator has the -1.125*total penalty → doesn't propose immediately
+    _, nv = vals2.get_by_address(new_key.pub_key().address())
+    assert nv.proposer_priority < 0
+    # removal
+    vals2.update_with_change_set([Validator(new_key.pub_key(), 0)])
+    assert len(vals2) == 4
+    assert vals2.hash() == h0
+    # set cannot become empty
+    with pytest.raises(ValueError):
+        empty_changes = [Validator(v.pub_key, 0) for v in vals2.validators]
+        vals2.update_with_change_set(empty_changes)
+
+
+def test_validator_set_roundtrip():
+    vals, _ = tt.make_validator_set(4)
+    vals.increment_proposer_priority(3)
+    decoded = ValidatorSet.decode(vals.encode())
+    assert decoded.hash() == vals.hash()
+    assert [v.proposer_priority for v in decoded.validators] == [
+        v.proposer_priority for v in vals.validators
+    ]
+    assert decoded.get_proposer().address == vals.get_proposer().address
+
+
+def test_vote_set_two_thirds():
+    vals, keys = tt.make_validator_set(4)
+    vs = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vals)
+    bid = tt.make_block_id()
+    ordered_keys = [keys[v.address] for v in vals.validators]
+    for i in range(3):
+        added = vs.add_vote(
+            tt.make_vote(CHAIN, ordered_keys[i], i, 5, 0, SignedMsgType.PRECOMMIT, bid)
+        )
+        assert added
+    assert vs.has_two_thirds_majority()
+    assert vs.two_thirds_majority() == bid
+    commit = vs.make_commit()
+    assert commit.size() == 4
+    validation.verify_commit_light(CHAIN, vals, bid, 5, commit)
+
+
+def test_vote_set_rejects_bad_votes():
+    vals, keys = tt.make_validator_set(4)
+    vs = VoteSet(CHAIN, 5, 0, SignedMsgType.PRECOMMIT, vals)
+    bid = tt.make_block_id()
+    ordered_keys = [keys[v.address] for v in vals.validators]
+    # wrong height
+    with pytest.raises(VoteSetError):
+        vs.add_vote(tt.make_vote(CHAIN, ordered_keys[0], 0, 6, 0, SignedMsgType.PRECOMMIT, bid))
+    # wrong index/address pairing
+    with pytest.raises(VoteSetError):
+        vs.add_vote(tt.make_vote(CHAIN, ordered_keys[0], 1, 5, 0, SignedMsgType.PRECOMMIT, bid))
+    # bad signature (signed for different chain)
+    bad = tt.make_vote("bad-chain", ordered_keys[0], 0, 5, 0, SignedMsgType.PRECOMMIT, bid)
+    with pytest.raises(VoteSetError, match="signature"):
+        vs.add_vote(bad)
+    # conflicting vote -> evidence path
+    v1 = tt.make_vote(CHAIN, ordered_keys[0], 0, 5, 0, SignedMsgType.PRECOMMIT, bid)
+    assert vs.add_vote(v1)
+    assert not vs.add_vote(v1)  # exact duplicate ok, not added
+    v2 = tt.make_vote(
+        CHAIN, ordered_keys[0], 0, 5, 0, SignedMsgType.PRECOMMIT, tt.make_block_id(b"fork")
+    )
+    with pytest.raises(ConflictingVoteError):
+        vs.add_vote(v2)
+
+
+def test_part_set_roundtrip():
+    data = os.urandom(200_000)
+    ps = PartSet.from_data(data, part_size=65536)
+    assert ps.is_complete()
+    assert ps.header.total == 4
+    # reassemble into a fresh set out of order
+    ps2 = PartSet(ps.header)
+    for idx in [3, 0, 2, 1]:
+        assert ps2.add_part(ps.get_part(idx))
+    assert ps2.is_complete()
+    assert ps2.assemble() == data
+    # tampered part rejected
+    ps3 = PartSet(ps.header)
+    p = ps.get_part(0)
+    from tendermint_tpu.types.part_set import Part
+
+    with pytest.raises(ValueError):
+        ps3.add_part(Part(0, p.bytes_ + b"x", p.proof))
+
+
+def test_block_roundtrip_and_validate():
+    vals, keys = tt.make_validator_set(4)
+    bid = tt.make_block_id()
+    last_commit = tt.make_commit(CHAIN, 1, 0, bid, vals, keys)
+    txs = (b"tx1", b"tx2")
+    header = Header(
+        chain_id=CHAIN,
+        height=2,
+        time_ns=123456789,
+        last_block_id=bid,
+        last_commit_hash=last_commit.hash(),
+        data_hash=txs_hash(txs),
+        validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        consensus_hash=ConsensusParams().hash(),
+        app_hash=b"\x01" * 32,
+        last_results_hash=b"",
+        evidence_hash=b"",
+        proposer_address=vals.get_proposer().address,
+    )
+    block = Block(header, txs, (), last_commit)
+    block.validate_basic()
+    decoded = Block.decode(block.encode())
+    assert decoded.hash() == block.hash()
+    assert decoded.txs == txs
+    assert decoded.last_commit.hash() == last_commit.hash()
+
+
+def test_vote_proposal_roundtrip():
+    vals, keys = tt.make_validator_set(1)
+    k = list(keys.values())[0]
+    bid = tt.make_block_id()
+    v = tt.make_vote(CHAIN, k, 0, 7, 2, SignedMsgType.PREVOTE, bid)
+    v.validate_basic()
+    assert Vote.decode(v.encode()) == v
+    p = Proposal(7, 2, -1, bid, 999, b"")
+    sb = p.sign_bytes(CHAIN)
+    p2 = Proposal(7, 2, -1, bid, 999, k.sign(sb))
+    p2.validate_basic()
+    assert Proposal.decode(p2.encode()) == p2
+    assert k.pub_key().verify_signature(p2.sign_bytes(CHAIN), p2.signature)
+
+
+def test_duplicate_vote_evidence():
+    vals, keys = tt.make_validator_set(4)
+    ordered_keys = [keys[v.address] for v in vals.validators]
+    bid_a, bid_b = tt.make_block_id(b"a"), tt.make_block_id(b"b")
+    va = tt.make_vote(CHAIN, ordered_keys[0], 0, 5, 0, SignedMsgType.PRECOMMIT, bid_a)
+    vb = tt.make_vote(CHAIN, ordered_keys[0], 0, 5, 0, SignedMsgType.PRECOMMIT, bid_b)
+    ev = DuplicateVoteEvidence.from_votes(va, vb, 1000, vals)
+    ev.validate_basic()
+    dec = decode_evidence(ev.encode())
+    assert dec == ev
+    with pytest.raises(ValueError):
+        DuplicateVoteEvidence.from_votes(va, va, 1000, vals).validate_basic()
+
+
+def test_genesis_roundtrip():
+    vals, _ = tt.make_validator_set(3)
+    doc = GenesisDoc(
+        chain_id=CHAIN,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[GenesisValidator(v.pub_key, v.voting_power) for v in vals.validators],
+        app_state=b'{"accounts": []}',
+    )
+    doc2 = GenesisDoc.from_json(doc.to_json())
+    assert doc2.chain_id == doc.chain_id
+    assert doc2.validator_set().hash() == vals.hash()
+    assert doc2.app_state == doc.app_state
+    assert doc.hash() == doc2.hash()
+
+
+def test_consensus_params_roundtrip():
+    p = ConsensusParams()
+    p.validate_basic()
+    assert ConsensusParams.decode(p.encode()) == p
+    assert p.hash() == ConsensusParams.decode(p.encode()).hash()
