@@ -1,0 +1,124 @@
+#!/usr/bin/env python3
+"""Headline benchmark: commit signatures verified per second on a
+150-validator chain (BASELINE.md config 1/3 — the block-sync verification
+hot path).
+
+Procedure:
+  1. Build a 150-validator ed25519 set and a range of signed commits
+     (the shape block-sync sees when replaying history).
+  2. CPU baseline: single-threaded host verification of one commit's
+     signatures (OpenSSL-backed — the stand-in for the reference's Go
+     ed25519, which is not runnable in this image).
+  3. TPU path: range-batched verification — all commits' signatures in one
+     kernel launch (how blocksync batches ranges of historical commits),
+     end-to-end including host sign-bytes construction and hashing.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import numpy as np
+
+    from tendermint_tpu import testing as tt
+    from tendermint_tpu.crypto.batch import CPUBatchVerifier
+    from tendermint_tpu.crypto.tpu import verify as tpuv
+
+    n_vals = 150
+    chain_id = "bench-chain"
+    log(f"building {n_vals}-validator set + commits …")
+    vals, keys = tt.make_validator_set(n_vals, power=10)
+
+    # enough commits that the padded batch lands on the 8192 bucket
+    n_commits = 54
+    commits = []
+    for h in range(1, n_commits + 1):
+        bid = tt.make_block_id(b"block-%d" % h)
+        commits.append((bid, tt.make_commit(chain_id, h, 0, bid, vals, keys)))
+
+    # flatten to (pub, msg, sig) triples — the block-sync range batch
+    items = []
+    for _, commit in commits:
+        for idx, cs in enumerate(commit.signatures):
+            val = vals.validators[idx]
+            items.append(
+                (val.pub_key.bytes(), commit.vote_sign_bytes(chain_id, idx), cs.signature)
+            )
+    log(f"{len(commits)} commits, {len(items)} signatures")
+
+    # -- CPU baseline -----------------------------------------------------
+    base_items = items[: n_vals * 4]
+    bv = CPUBatchVerifier()
+    for pub, msg, sig in base_items:
+        from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
+
+        bv.add(Ed25519PubKey(pub), msg, sig)
+    t0 = time.perf_counter()
+    ok, bitmap = bv.verify()
+    cpu_dt = time.perf_counter() - t0
+    assert ok, "CPU baseline verification failed"
+    cpu_rate = len(base_items) / cpu_dt
+    log(f"CPU baseline: {cpu_rate:,.0f} sigs/s ({cpu_dt*1e3:.1f} ms / {len(base_items)})")
+
+    # -- TPU path ---------------------------------------------------------
+    import jax
+
+    backend = jax.devices()[0].platform
+    log(f"jax backend: {backend} ({jax.devices()})")
+
+    # warmup (compile)
+    t0 = time.perf_counter()
+    bitmap = tpuv.verify_batch(items)
+    assert bool(np.all(bitmap)), "TPU verification failed on valid commits"
+    log(f"warmup+compile: {time.perf_counter()-t0:.1f}s")
+
+    reps = 3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        bitmap = tpuv.verify_batch(items)
+    tpu_dt = (time.perf_counter() - t0) / reps
+    assert bool(np.all(bitmap))
+    tpu_rate = len(items) / tpu_dt
+    log(f"TPU end-to-end: {tpu_rate:,.0f} sigs/s ({tpu_dt*1e3:.1f} ms / {len(items)})")
+
+    print(
+        json.dumps(
+            {
+                "metric": "commit sigs verified/sec (150-validator commits, ed25519, range-batched)",
+                "value": round(tpu_rate, 1),
+                "unit": "sigs/sec",
+                "vs_baseline": round(tpu_rate / cpu_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit the one line the driver expects
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(
+            json.dumps(
+                {
+                    "metric": "commit sigs verified/sec (150-validator commits, ed25519, range-batched)",
+                    "value": 0,
+                    "unit": "sigs/sec",
+                    "vs_baseline": 0,
+                    "error": repr(e),
+                }
+            )
+        )

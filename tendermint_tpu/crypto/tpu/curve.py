@@ -55,6 +55,10 @@ _BX_INT = _recover_x_int(_BY_INT, 0)
 BASE_X = F.int_to_limbs(_BX_INT)
 BASE_Y = F.int_to_limbs(_BY_INT)
 BASE_T = F.int_to_limbs(_BX_INT * _BY_INT % F.P_INT)
+# cached (Niels) form of the base point, as constants
+BASE_YMX = F.int_to_limbs(_BY_INT - _BX_INT)
+BASE_YPX = F.int_to_limbs(_BY_INT + _BX_INT)
+BASE_T2D = F.int_to_limbs(_BX_INT * _BY_INT % F.P_INT * 2 * F.D_INT)
 
 
 def base_point(batch_shape=()) -> Point:
@@ -62,21 +66,67 @@ def base_point(batch_shape=()) -> Point:
     return Point(bc(BASE_X), bc(BASE_Y), bc(F.ONE), bc(BASE_T))
 
 
+class CachedPoint(NamedTuple):
+    """Precomputed ('Niels') form of an addition operand: (Y-X, Y+X, 2dT,
+    2Z). Table points are converted once before the 256-step scan, saving a
+    field multiply and two carries per addition."""
+
+    ymx: jnp.ndarray
+    ypx: jnp.ndarray
+    t2d: jnp.ndarray
+    z2: jnp.ndarray
+
+
+def to_cached(p: Point) -> CachedPoint:
+    (t2d,) = F.mul_many([(p.t, jnp.asarray(F.D2_LIMBS))])
+    return CachedPoint(F.sub(p.y, p.x), F.add_c(p.y, p.x), t2d, F.mul_scalar(p.z, 2))
+
+
+def cached_identity(batch_shape=()) -> CachedPoint:
+    one = jnp.broadcast_to(jnp.asarray(F.ONE), batch_shape + (F.LIMBS,))
+    zero = jnp.zeros(batch_shape + (F.LIMBS,), jnp.int32)
+    return CachedPoint(one, one, zero, F.mul_scalar(one, 2))
+
+
 def point_add(p: Point, q: Point) -> Point:
     """Complete addition (RFC 8032 §5.1.4 'add-2008-hwcd-3')."""
-    a = F.mul(F.sub(p.y, p.x), F.sub(q.y, q.x))
-    b = F.mul(F.add(p.y, p.x), F.add(q.y, q.x))
-    c = F.mul(F.mul(p.t, jnp.asarray(F.D2_LIMBS)), q.t)
-    d = F.mul(F.mul_scalar(p.z, 2), q.z)
+    return add_cached(p, to_cached(q))
+
+
+def add_cached(p: Point, q: CachedPoint) -> Point:
+    """Complete addition of an extended point and a cached point — two
+    stacked convolutions total."""
+    a, b, c, d = F.mul_many(
+        [
+            (F.sub(p.y, p.x), q.ymx),
+            (F.add_c(p.y, p.x), q.ypx),
+            (p.t, q.t2d),
+            (p.z, q.z2),
+        ]
+    )
     e = F.sub(b, a)
     f = F.sub(d, c)
     g = F.add_c(d, c)
     h = F.add_c(b, a)
-    return Point(F.mul(e, f), F.mul(g, h), F.mul(f, g), F.mul(e, h))
+    x, y, z, t = F.mul_many([(e, f), (g, h), (f, g), (e, h)])
+    return Point(x, y, z, t)
 
 
 def point_double(p: Point) -> Point:
-    return point_add(p, p)
+    """Doubling via EFD 'dbl-2008-hwcd' with a=-1 — square-only first
+    stage, no d constant, exact for every input point.
+
+    With a=-1: D=-A, E=(X+Y)²-A-B, G=B-A, F=G-C, H=-(A+B);
+    X3=E·F, Y3=G·H, Z3=F·G, T3=E·H."""
+    xys = F.add_c(p.x, p.y)
+    xx, yy, zz, xy2 = F.mul_many([(p.x, p.x), (p.y, p.y), (p.z, p.z), (xys, xys)])
+    apb = F.add_c(xx, yy)  # A+B
+    e = F.sub(xy2, apb)  # E
+    g = F.sub(yy, xx)  # G
+    f = F.sub(g, F.mul_scalar(zz, 2))  # F = G - 2Z²
+    negh = F.neg(apb)  # H = -(A+B)
+    x, y, z, t = F.mul_many([(e, f), (g, negh), (f, g), (e, negh)])
+    return Point(x, y, z, t)
 
 
 def point_neg(p: Point) -> Point:
@@ -96,9 +146,10 @@ def point_select(mask: jnp.ndarray, p: Point, q: Point) -> Point:
 
 def point_eq(p: Point, q: Point) -> jnp.ndarray:
     """Projective equality: X1·Z2 == X2·Z1 and Y1·Z2 == Y2·Z1."""
-    return F.eq(F.mul(p.x, q.z), F.mul(q.x, p.z)) & F.eq(
-        F.mul(p.y, q.z), F.mul(q.y, p.z)
+    x1z2, x2z1, y1z2, y2z1 = F.mul_many(
+        [(p.x, q.z), (q.x, p.z), (p.y, q.z), (q.y, p.z)]
     )
+    return F.eq(x1z2, x2z1) & F.eq(y1z2, y2z1)
 
 
 def is_identity(p: Point) -> jnp.ndarray:
@@ -149,21 +200,40 @@ def decompress(y_bytes: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
     return Point(x, y, jnp.broadcast_to(jnp.asarray(F.ONE), y.shape), F.mul(x, y)), valid
 
 
+def cached_select(mask: jnp.ndarray, p: CachedPoint, q: CachedPoint) -> CachedPoint:
+    m = mask[..., None]
+    return CachedPoint(
+        jnp.where(m, p.ymx, q.ymx),
+        jnp.where(m, p.ypx, q.ypx),
+        jnp.where(m, p.t2d, q.t2d),
+        jnp.where(m, p.z2, q.z2),
+    )
+
+
 def scalar_mul_double(
     s_bits: jnp.ndarray, h_bits: jnp.ndarray, a_neg: Point
 ) -> Point:
     """Joint double-scalar multiplication: returns s·B + h·(-A), batched.
 
     s_bits, h_bits: (..., 256) int32 in {0,1}, little-endian bit order.
-    Runs one 256-iteration lax.scan (MSB first): Q = 2Q; Q += table[bits],
-    table = [Id, B, -A, B-A] selected branchlessly per element.
+    One 256-iteration lax.scan (MSB first): Q = 2Q; Q += table[bits], where
+    table = [Id, B, -A, B-A] is precomputed in cached (Niels) form and
+    selected branchlessly per batch element.
     """
     import jax
 
     batch_shape = s_bits.shape[:-1]
     idp = identity(batch_shape)
-    bp = base_point(batch_shape)
-    b_plus_an = point_add(bp, a_neg)
+
+    def bc(arr):
+        return jnp.broadcast_to(jnp.asarray(arr), batch_shape + (F.LIMBS,))
+
+    b_cached = CachedPoint(
+        bc(BASE_YMX), bc(BASE_YPX), bc(BASE_T2D), bc(F.int_to_limbs(2))
+    )
+    an_cached = to_cached(a_neg)
+    ban_cached = to_cached(add_cached(base_point(batch_shape), an_cached))
+    id_cached = cached_identity(batch_shape)
 
     # scan over bits MSB->LSB: move bit axis to front, reversed
     sb = jnp.moveaxis(s_bits[..., ::-1], -1, 0)  # (256, ...)
@@ -174,13 +244,12 @@ def scalar_mul_double(
         q = point_double(q)
         sel_s = sbit.astype(bool)
         sel_h = hbit.astype(bool)
-        # table select: (sel_s, sel_h) -> Id / B / -A / B-A
-        t = point_select(
+        t = cached_select(
             sel_s,
-            point_select(sel_h, b_plus_an, bp),
-            point_select(sel_h, a_neg, idp),
+            cached_select(sel_h, ban_cached, b_cached),
+            cached_select(sel_h, an_cached, id_cached),
         )
-        return point_add(q, t), None
+        return add_cached(q, t), None
 
     q, _ = jax.lax.scan(step, idp, (sb, hb))
     return q

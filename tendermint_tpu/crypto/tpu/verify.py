@@ -21,6 +21,7 @@ implicit all-gather of the validity bitmap.
 from __future__ import annotations
 
 import hashlib
+import os
 from functools import partial
 
 import numpy as np
@@ -56,6 +57,30 @@ def _kernel(a_bytes, r_bytes, s_bits, h_bits, s_valid):
 
 _jitted_kernel = None
 _sharded_kernels: dict[int, object] = {}
+_cache_ready = False
+
+
+def _ensure_compile_cache() -> None:
+    """Persist XLA compilations to disk — the verification kernel is large
+    (a 256-step scan over wide straight-line group arithmetic) and costs
+    minutes to compile per batch bucket; the cache makes that a one-time
+    cost across processes and rounds."""
+    global _cache_ready
+    if _cache_ready:
+        return
+    import jax
+
+    cache_dir = os.environ.get(
+        "TMTPU_COMPILE_CACHE", os.path.expanduser("~/.cache/tendermint_tpu_xla")
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass  # cache is an optimization, never a requirement
+    _cache_ready = True
 
 
 def _get_kernel():
@@ -63,6 +88,7 @@ def _get_kernel():
     if _jitted_kernel is None:
         import jax
 
+        _ensure_compile_cache()
         _jitted_kernel = jax.jit(_kernel)
     return _jitted_kernel
 
@@ -75,6 +101,7 @@ def make_sharded_kernel(mesh, axis: str = "data"):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    _ensure_compile_cache()
     data = NamedSharding(mesh, P(axis))
     return jax.jit(
         _kernel,
