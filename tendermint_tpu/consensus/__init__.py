@@ -1,0 +1,14 @@
+"""Consensus layer (reference internal/consensus/)."""
+
+from .replay import AppHashMismatchError, Handshaker, HandshakeError
+from .wal import WAL, WALRecord, KIND_END_HEIGHT, KIND_MESSAGE
+
+__all__ = [
+    "AppHashMismatchError",
+    "Handshaker",
+    "HandshakeError",
+    "WAL",
+    "WALRecord",
+    "KIND_END_HEIGHT",
+    "KIND_MESSAGE",
+]

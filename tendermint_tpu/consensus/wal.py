@@ -1,0 +1,209 @@
+"""Write-ahead log (reference internal/consensus/wal.go, autofile group).
+
+Every consensus input (peer msg, internal msg, timeout) is WAL-written
+before it is processed, so a crashed node can deterministically replay to
+its pre-crash state. Records are CRC32+length framed; `EndHeight` marker
+records delimit completed heights (reference wal.go:288 WALEncoder,
+EndHeightMessage).
+
+Files: `wal` is the head; at `head_size_limit` it rotates to `wal.000`,
+`wal.001`, … (the autofile.Group analog); replay reads rotated files in
+order, then the head."""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Iterator
+
+from ..libs import protoenc as pe
+
+_FRAME = struct.Struct("<II")  # crc32, length
+MAX_RECORD_SIZE = 1 << 20
+
+KIND_MESSAGE = 1
+KIND_END_HEIGHT = 2
+
+
+@dataclass(frozen=True)
+class WALRecord:
+    kind: int
+    time_ns: int
+    data: bytes  # opaque consensus message (KIND_MESSAGE)
+    height: int = 0  # KIND_END_HEIGHT
+
+    def encode(self) -> bytes:
+        out = pe.varint_field(1, self.kind)
+        out += pe.varint_field(2, self.time_ns)
+        if self.kind == KIND_END_HEIGHT:
+            out += pe.varint_field(3, self.height)
+        else:
+            out += pe.bytes_field(4, self.data)
+        return out
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "WALRecord":
+        r = pe.Reader(raw)
+        kind, time_ns, height, data = KIND_MESSAGE, 0, 0, b""
+        while not r.eof():
+            f, wt = r.read_tag()
+            if f == 1:
+                kind = r.read_uvarint()
+            elif f == 2:
+                time_ns = r.read_uvarint()
+            elif f == 3:
+                height = r.read_uvarint()
+            elif f == 4:
+                data = r.read_bytes()
+            else:
+                r.skip(wt)
+        return cls(kind, time_ns, data, height)
+
+
+class WALCorruptionError(RuntimeError):
+    pass
+
+
+class WAL:
+    def __init__(
+        self,
+        directory: str,
+        *,
+        head_size_limit: int = 10 * 1024 * 1024,
+        total_size_limit: int = 1024 * 1024 * 1024,
+    ):
+        self.dir = directory
+        self.head_size_limit = head_size_limit
+        self.total_size_limit = total_size_limit
+        os.makedirs(directory, exist_ok=True)
+        self._head_path = os.path.join(directory, "wal")
+        self._f = open(self._head_path, "ab")
+
+    # -- writing ---------------------------------------------------------
+
+    def _write_record(self, rec: WALRecord, sync: bool) -> None:
+        payload = rec.encode()
+        if len(payload) > MAX_RECORD_SIZE:
+            raise ValueError("WAL record too big")
+        frame = _FRAME.pack(zlib.crc32(payload), len(payload))
+        self._f.write(frame + payload)
+        if sync:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+        if self._f.tell() >= self.head_size_limit:
+            self._rotate()
+
+    def write(self, data: bytes, time_ns: int = 0) -> None:
+        """Buffered write (group-flushed; reference wal.go Write)."""
+        self._write_record(WALRecord(KIND_MESSAGE, time_ns, data), sync=False)
+
+    def write_sync(self, data: bytes, time_ns: int = 0) -> None:
+        """Fsync'd write — used for messages about to be acted on
+        (reference wal.go WriteSync)."""
+        self._write_record(WALRecord(KIND_MESSAGE, time_ns, data), sync=True)
+
+    def write_end_height(self, height: int) -> None:
+        self._write_record(WALRecord(KIND_END_HEIGHT, 0, b"", height), sync=True)
+
+    def flush(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        except (OSError, ValueError):
+            pass
+        self._f.close()
+
+    # -- rotation --------------------------------------------------------
+
+    def _rotated_files(self) -> list[str]:
+        names = sorted(
+            (n for n in os.listdir(self.dir) if n.startswith("wal.") and n[4:].isdigit()),
+            key=lambda n: int(n[4:]),
+        )
+        return [os.path.join(self.dir, n) for n in names]
+
+    def _rotate(self) -> None:
+        self._f.flush()
+        self._f.close()
+        existing = self._rotated_files()
+        idx = (
+            int(os.path.basename(existing[-1])[4:]) + 1 if existing else 0
+        )
+        os.rename(self._head_path, os.path.join(self.dir, f"wal.{idx:03d}"))
+        self._f = open(self._head_path, "ab")
+        # enforce the group size cap by dropping the oldest rotated file
+        files = self._rotated_files()
+        total = sum(os.path.getsize(p) for p in files) + self._f.tell()
+        while files and total > self.total_size_limit:
+            total -= os.path.getsize(files[0])
+            os.remove(files.pop(0))
+
+    # -- reading ---------------------------------------------------------
+
+    def _all_files(self) -> list[str]:
+        files = self._rotated_files()
+        if os.path.exists(self._head_path):
+            files.append(self._head_path)
+        return files
+
+    def iter_records(self, *, strict: bool = False) -> Iterator[WALRecord]:
+        """Replay all records oldest-first. A torn tail frame (crash during
+        write) terminates iteration; corruption mid-log raises in strict
+        mode (reference WALDecoder semantics)."""
+        self._f.flush()
+        for path in self._all_files():
+            with open(path, "rb") as f:
+                is_head = path == self._head_path
+                while True:
+                    frame = f.read(_FRAME.size)
+                    if not frame:
+                        break
+                    if len(frame) < _FRAME.size:
+                        if strict and not is_head:
+                            raise WALCorruptionError(f"torn frame in {path}")
+                        return
+                    crc, length = _FRAME.unpack(frame)
+                    if length > MAX_RECORD_SIZE:
+                        if strict:
+                            raise WALCorruptionError(f"oversized record in {path}")
+                        return
+                    payload = f.read(length)
+                    if len(payload) < length:
+                        if strict and not is_head:
+                            raise WALCorruptionError(f"torn payload in {path}")
+                        return
+                    if zlib.crc32(payload) != crc:
+                        if strict:
+                            raise WALCorruptionError(f"CRC mismatch in {path}")
+                        return
+                    yield WALRecord.decode(payload)
+
+    def search_for_end_height(self, height: int) -> list[WALRecord] | None:
+        """Messages recorded after `#ENDHEIGHT: height` (reference
+        wal.go:231 SearchForEndHeight) — i.e. everything belonging to
+        height+1. Returns None if the marker is absent. Height 0 matches
+        the start of the log (fresh chain)."""
+        if height == 0:
+            found = True
+            out: list[WALRecord] = []
+        else:
+            found = False
+            out = []
+        for rec in self.iter_records():
+            if rec.kind == KIND_END_HEIGHT:
+                if rec.height == height:
+                    found = True
+                    out = []
+                elif found and rec.height > height:
+                    # next height completed too; keep collecting — replay
+                    # handles duplicates idempotently
+                    pass
+                continue
+            if found:
+                out.append(rec)
+        return out if found else None

@@ -1,0 +1,377 @@
+"""Stage-3 tests: ABCI app, stores, block executor, WAL, handshake replay.
+
+Mirrors the reference's internal/state/{execution,validation,store}_test.go
+and internal/consensus/{wal,replay}_test.go shapes: build a real chain
+against the kvstore app, crash it at different points, and check recovery.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+
+import pytest
+
+from tendermint_tpu import testing as tt
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.kvstore import KVStoreApp
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.consensus.wal import WAL, KIND_END_HEIGHT
+from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.mempool import Mempool, _NullLock
+from tendermint_tpu.proxy import AppConns
+from tendermint_tpu.state import BlockExecutor, StateStore, state_from_genesis
+from tendermint_tpu.state.validation import BlockValidationError
+from tendermint_tpu.store.blockstore import BlockStore
+from tendermint_tpu.store.db import MemDB, SQLiteDB
+from tendermint_tpu.types.block import BlockID
+from tendermint_tpu.types.events import EventBus, query_for_event, EVENT_NEW_BLOCK
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+
+class ListMempool(Mempool):
+    """Minimal mempool: a FIFO the tests stuff txs into."""
+
+    def __init__(self):
+        self.txs: list[bytes] = []
+
+    async def check_tx(self, tx, sender=""):
+        self.txs.append(tx)
+
+    def reap_max_bytes_max_gas(self, max_bytes, max_gas):
+        return list(self.txs)
+
+    def reap_max_txs(self, max_txs):
+        return self.txs[:max_txs]
+
+    def lock(self):
+        return _NullLock()
+
+    async def update(self, height, txs, results, *, recheck=True):
+        self.txs = [t for t in self.txs if t not in set(txs)]
+
+    def size(self):
+        return len(self.txs)
+
+    def size_bytes(self):
+        return sum(len(t) for t in self.txs)
+
+    async def flush(self):
+        self.txs = []
+
+
+def make_genesis(n_vals=4, chain_id="exec-chain"):
+    vals, keys = tt.make_validator_set(n_vals)
+    doc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(v.pub_key, v.voting_power) for v in vals.validators
+        ],
+    )
+    return doc, vals, keys
+
+
+class Harness:
+    """One in-process node: app + stores + executor (no consensus SM yet —
+    commits are forged by signing with all validator keys)."""
+
+    def __init__(self, tmp=None, suffix=""):
+        self.doc, self.vals, self.keys = make_genesis()
+        if tmp is None:
+            self.app_db, self.block_db, self.state_db = MemDB(), MemDB(), MemDB()
+        else:
+            self.app_db = SQLiteDB(os.path.join(tmp, f"app{suffix}.db"))
+            self.block_db = SQLiteDB(os.path.join(tmp, f"blocks{suffix}.db"))
+            self.state_db = SQLiteDB(os.path.join(tmp, f"state{suffix}.db"))
+        self.reopen()
+
+    def reopen(self):
+        self.app = KVStoreApp(self.app_db)
+        self.conns = AppConns.local(self.app)
+        self.block_store = BlockStore(self.block_db)
+        self.state_store = StateStore(self.state_db)
+        self.mempool = ListMempool()
+        self.event_bus = EventBus()
+        self.executor = BlockExecutor(
+            self.state_store,
+            self.conns.consensus,
+            self.mempool,
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+        )
+
+    async def handshake(self):
+        state = self.state_store.load()
+        if state is None:
+            state = state_from_genesis(self.doc)
+        hs = Handshaker(self.state_store, state, self.block_store, self.doc)
+        return await hs.handshake(self.conns)
+
+    def forge_commit(self, state, block, part_set):
+        bid = BlockID(block.hash(), part_set.header)
+        return bid, tt.make_commit(
+            state.chain_id, block.header.height, 0, bid, self.vals, self.keys
+        )
+
+    async def advance(self, state, last_commit, txs=()):
+        """Propose + 'decide' + apply one block; returns (state, commit)."""
+        for tx in txs:
+            await self.mempool.check_tx(tx)
+        height = state.last_block_height + 1 if state.last_block_height else state.initial_height
+        proposer = state.validators.get_proposer().address
+        block, parts = self.executor.create_proposal_block(
+            height, state, last_commit, proposer
+        )
+        bid, commit = self.forge_commit(state, block, parts)
+        self.block_store.save_block(block, parts, commit)
+        state, _ = await self.executor.apply_block(state, bid, block)
+        return state, commit
+
+
+# ---------------------------------------------------------------------------
+
+
+def test_kvstore_app_basics():
+    app = KVStoreApp()
+    assert app.check_tx(abci.RequestCheckTx(b"k=v")).is_ok()
+    assert not app.check_tx(abci.RequestCheckTx(b"a=b=c")).is_ok()
+    app.begin_block(abci.RequestBeginBlock(b"", None, abci.LastCommitInfo(0)))
+    assert app.deliver_tx(abci.RequestDeliverTx(b"name=satoshi")).is_ok()
+    app.end_block(abci.RequestEndBlock(1))
+    res = app.commit()
+    assert res.data
+    q = app.query(abci.RequestQuery(data=b"name"))
+    assert q.value == b"satoshi"
+    assert app.query(abci.RequestQuery(data=b"missing")).code == 1
+    # validator tx
+    pk = bytes(range(32))
+    res = app.check_tx(abci.RequestCheckTx(b"val:" + pk.hex().encode() + b"!5"))
+    assert res.is_ok()
+    assert not app.check_tx(abci.RequestCheckTx(b"val:zz!5")).is_ok()
+
+
+def test_chain_advances_and_persists():
+    async def run():
+        h = Harness()
+        state = await h.handshake()
+        assert state.last_block_height == 0
+
+        sub = h.event_bus.subscribe("test", query_for_event(EVENT_NEW_BLOCK))
+        commit = None
+        state, commit = await h.advance(state, commit, [b"a=1", b"b=2"])
+        state, commit = await h.advance(state, commit, [b"c=3"])
+        state, commit = await h.advance(state, commit)
+        assert state.last_block_height == 3
+        assert h.block_store.height() == 3
+
+        # app executed the txs
+        assert h.app.items[b"a"] == b"1"
+        assert h.app.items[b"c"] == b"3"
+        # header chains to app state
+        b3 = h.block_store.load_block(3)
+        assert b3.header.app_hash  # app hash of height 2
+        b2 = h.block_store.load_block(2)
+        assert b3.header.app_hash != b2.header.app_hash
+        assert b3.header.last_block_id.hash == b2.hash()
+        # canonical commit for height 2 comes from block 3's LastCommit
+        c2 = h.block_store.load_block_commit(2)
+        assert c2.block_id.hash == b2.hash()
+        # lookup by hash
+        assert h.block_store.load_block_by_hash(b2.hash()).header.height == 2
+        # state store: validators at each height
+        for height in (1, 2, 3):
+            vs = h.state_store.load_validators(height)
+            assert vs is not None and vs.hash() == h.vals.hash()
+        # abci responses persisted
+        r1 = h.state_store.load_abci_responses(1)
+        assert len(r1.deliver_txs) == 2
+        # events fired
+        msg = await asyncio.wait_for(sub.next(), 1)
+        assert msg.data.block.header.height == 1
+        # mempool drained
+        assert h.mempool.size() == 0
+
+    asyncio.run(run())
+
+
+def test_validate_block_rejects_tampering():
+    async def run():
+        h = Harness()
+        state = await h.handshake()
+        state, commit = await h.advance(state, None, [b"x=1"])
+
+        proposer = state.validators.get_proposer().address
+        block, parts = h.executor.create_proposal_block(2, state, commit, proposer)
+
+        import dataclasses
+
+        bad = dataclasses.replace(
+            block, header=dataclasses.replace(block.header, app_hash=b"\x00" * 32)
+        )
+        with pytest.raises(BlockValidationError):
+            h.executor.validate_block(state, bad)
+
+        bad2 = dataclasses.replace(
+            block, header=dataclasses.replace(block.header, height=5)
+        )
+        with pytest.raises(BlockValidationError):
+            h.executor.validate_block(state, bad2)
+
+        # good block passes
+        h.executor.validate_block(state, block)
+
+    asyncio.run(run())
+
+
+def test_validator_update_via_tx():
+    async def run():
+        h = Harness()
+        state = await h.handshake()
+        new_key = tt.det_priv_keys(1, seed=b"new-validator")[0]
+        tx = b"val:" + new_key.pub_key().bytes().hex().encode() + b"!7"
+        state, commit = await h.advance(state, None, [tx])
+        # joins NextValidators two heights later (validators for h+2)
+        assert len(state.next_validators) == 5
+        assert len(state.validators) == 4
+        state, commit = await h.advance(state, commit)
+        assert len(state.validators) == 5
+        assert state.last_height_validators_changed == 3
+
+    asyncio.run(run())
+
+
+def test_handshake_replays_app_behind_store(tmp_path):
+    async def run():
+        tmp = str(tmp_path)
+        h = Harness(tmp)
+        state = await h.handshake()
+        commit = None
+        for i in range(5):
+            state, commit = await h.advance(state, commit, [b"k%d=v%d" % (i, i)])
+        app_hash = state.app_hash
+        h.app_db.close(); h.block_db.close(); h.state_db.close()
+
+        # "crash" with the app's disk wiped → app height 0, store height 5
+        os.remove(os.path.join(tmp, "app.db"))
+        h2 = Harness(tmp)
+        state2 = await h2.handshake()
+        assert state2.last_block_height == 5
+        assert state2.app_hash == app_hash
+        assert h2.app.height == 5
+        assert h2.app.items[b"k4"] == b"v4"
+
+    asyncio.run(run())
+
+
+def test_handshake_applies_tip_block(tmp_path):
+    async def run():
+        tmp = str(tmp_path)
+        h = Harness(tmp)
+        state = await h.handshake()
+        state, commit = await h.advance(state, None, [b"a=1"])
+
+        # crash between SaveBlock and ApplyBlock: block 2 saved, state at 1
+        proposer = state.validators.get_proposer().address
+        block, parts = h.executor.create_proposal_block(2, state, commit, proposer)
+        bid, c2 = h.forge_commit(state, block, parts)
+        h.block_store.save_block(block, parts, c2)
+        h.app_db.close(); h.block_db.close(); h.state_db.close()
+
+        h2 = Harness(tmp)
+        state2 = await h2.handshake()
+        assert state2.last_block_height == 2
+        assert h2.app.height == 2
+        assert state2.app_hash == h2.app.app_hash
+
+    asyncio.run(run())
+
+
+def test_kvstore_snapshots():
+    app = KVStoreApp()
+    for height in range(1, 11):
+        app.begin_block(abci.RequestBeginBlock(b"", None, abci.LastCommitInfo(0)))
+        app.deliver_tx(abci.RequestDeliverTx(b"h%d=v" % height))
+        app.end_block(abci.RequestEndBlock(height))
+        app.commit()
+    snaps = app.list_snapshots().snapshots
+    assert len(snaps) == 1 and snaps[0].height == 10
+
+    app2 = KVStoreApp()
+    offer = app2.offer_snapshot(abci.RequestOfferSnapshot(snaps[0], app.app_hash))
+    assert offer.result == abci.OfferSnapshotResult.ACCEPT
+    for i in range(snaps[0].chunks):
+        chunk = app.load_snapshot_chunk(
+            abci.RequestLoadSnapshotChunk(snaps[0].height, snaps[0].format, i)
+        ).chunk
+        res = app2.apply_snapshot_chunk(abci.RequestApplySnapshotChunk(i, chunk))
+        assert res.result == abci.ApplySnapshotChunkResult.ACCEPT
+    assert app2.app_hash == app.app_hash
+    assert app2.items == app.items
+
+
+# -- WAL --------------------------------------------------------------------
+
+
+def test_wal_roundtrip_and_end_height(tmp_path):
+    wal = WAL(str(tmp_path / "wal"))
+    wal.write(b"msg-h1-a", time_ns=1)
+    wal.write_sync(b"msg-h1-b", time_ns=2)
+    wal.write_end_height(1)
+    wal.write(b"msg-h2-a", time_ns=3)
+    wal.close()
+
+    wal2 = WAL(str(tmp_path / "wal"))
+    recs = list(wal2.iter_records())
+    assert [r.data for r in recs if r.kind != KIND_END_HEIGHT] == [
+        b"msg-h1-a", b"msg-h1-b", b"msg-h2-a",
+    ]
+    after = wal2.search_for_end_height(1)
+    assert [r.data for r in after] == [b"msg-h2-a"]
+    assert wal2.search_for_end_height(7) is None
+    # height 0 = start of log
+    assert len(wal2.search_for_end_height(0)) == 3
+    wal2.close()
+
+
+def test_wal_truncates_torn_tail(tmp_path):
+    wal = WAL(str(tmp_path / "wal"))
+    wal.write_sync(b"complete", time_ns=1)
+    wal.close()
+    # simulate a crash mid-write: append garbage half-frame
+    with open(tmp_path / "wal" / "wal", "ab") as f:
+        f.write(b"\x01\x02\x03")
+    wal2 = WAL(str(tmp_path / "wal"))
+    recs = list(wal2.iter_records())
+    assert len(recs) == 1 and recs[0].data == b"complete"
+    wal2.close()
+
+
+def test_wal_rotation(tmp_path):
+    wal = WAL(str(tmp_path / "wal"), head_size_limit=256)
+    for i in range(50):
+        wal.write_sync(b"x" * 40, time_ns=i)
+    assert len(wal._rotated_files()) > 0
+    assert len(list(wal.iter_records())) == 50
+    wal.close()
+
+
+# -- pubsub query DSL -------------------------------------------------------
+
+
+def test_query_parse_and_match():
+    q = Query.parse("tm.event='Tx' AND tx.height>5")
+    assert q.matches({"tm.event": ["Tx"], "tx.height": ["7"]})
+    assert not q.matches({"tm.event": ["Tx"], "tx.height": ["3"]})
+    assert not q.matches({"tm.event": ["NewBlock"], "tx.height": ["7"]})
+
+    q2 = Query.parse("app.key EXISTS")
+    assert q2.matches({"app.key": ["anything"]})
+    assert not q2.matches({"other": ["x"]})
+
+    q3 = Query.parse("tx.hash CONTAINS 'AB'")
+    assert q3.matches({"tx.hash": ["ZZAB12"]})
+    assert not q3.matches({"tx.hash": ["zz12"]})
+
+    q4 = Query.parse("tx.height=7")
+    assert q4.matches({"tx.height": ["7"]})
