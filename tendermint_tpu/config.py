@@ -1,0 +1,63 @@
+"""Configuration tree (reference config/config.go:70). Grows with the
+framework; each section mirrors a reference config struct. TOML
+load/save lives with the CLI."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+MS = 1_000_000  # ns per millisecond
+
+
+@dataclass
+class ConsensusConfig:
+    """Timeouts in nanoseconds (reference config/config.go:1069-1093).
+    Per-round growth: timeout = base + delta * round."""
+
+    timeout_propose_ns: int = 3_000 * MS
+    timeout_propose_delta_ns: int = 500 * MS
+    timeout_prevote_ns: int = 1_000 * MS
+    timeout_prevote_delta_ns: int = 500 * MS
+    timeout_precommit_ns: int = 1_000 * MS
+    timeout_precommit_delta_ns: int = 500 * MS
+    timeout_commit_ns: int = 1_000 * MS
+    skip_timeout_commit: bool = False
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ns: int = 0
+    double_sign_check_height: int = 0
+    wal_path: str = "data/cs.wal"
+
+    def propose_timeout_ns(self, round_: int) -> int:
+        return self.timeout_propose_ns + self.timeout_propose_delta_ns * round_
+
+    def prevote_timeout_ns(self, round_: int) -> int:
+        return self.timeout_prevote_ns + self.timeout_prevote_delta_ns * round_
+
+    def precommit_timeout_ns(self, round_: int) -> int:
+        return self.timeout_precommit_ns + self.timeout_precommit_delta_ns * round_
+
+    def commit_time_ns(self, t_ns: int) -> int:
+        return t_ns + self.timeout_commit_ns
+
+
+@dataclass
+class MempoolConfig:
+    """Reference config/config.go:800-860."""
+
+    size: int = 5000
+    max_txs_bytes: int = 1024 * 1024 * 1024
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1024 * 1024
+    recheck: bool = True
+    broadcast: bool = True
+    ttl_num_blocks: int = 0
+    ttl_duration_ns: int = 0
+
+
+@dataclass
+class EvidenceConfig:
+    """Evidence-related consensus params live in types/params.py; this is
+    pool sizing."""
+
+    max_pending: int = 1000

@@ -1,0 +1,381 @@
+"""Consensus protocol messages (reference proto/tendermint/consensus/types.proto
+and internal/consensus/msgs.go).
+
+One union envelope `Message` with a type tag; used both on the wire
+(reactor channels) and in the WAL (wrapped in MsgInfo with the peer id,
+or TimeoutInfo for timer ticks — reference wal.go WALMessage)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..libs import protoenc as pe
+from ..libs.bits import BitArray
+from ..types.block import BlockID, NIL_BLOCK_ID
+from ..types.keys import SignedMsgType
+from ..types.part_set import Part
+from ..types.vote import Proposal, Vote
+from .ticker import TimeoutInfo
+from .types import RoundStep
+
+# message type tags (stable wire ids)
+T_NEW_ROUND_STEP = 1
+T_NEW_VALID_BLOCK = 2
+T_PROPOSAL = 3
+T_PROPOSAL_POL = 4
+T_BLOCK_PART = 5
+T_VOTE = 6
+T_HAS_VOTE = 7
+T_VOTE_SET_MAJ23 = 8
+T_VOTE_SET_BITS = 9
+
+# WAL record tags
+W_MSG_INFO = 1
+W_TIMEOUT = 2
+
+
+@dataclass(frozen=True)
+class NewRoundStepMessage:
+    """Peer's current HRS (reference msgs: NewRoundStep, gossiped on the
+    state channel every step change)."""
+
+    height: int
+    round: int
+    step: int
+    seconds_since_start_time: int
+    last_commit_round: int
+
+
+@dataclass(frozen=True)
+class NewValidBlockMessage:
+    height: int
+    round: int
+    block_part_set_header: tuple[int, bytes]
+    block_parts: BitArray
+    is_commit: bool
+
+
+@dataclass(frozen=True)
+class ProposalMessage:
+    proposal: Proposal
+
+
+@dataclass(frozen=True)
+class ProposalPOLMessage:
+    height: int
+    proposal_pol_round: int
+    proposal_pol: BitArray
+
+
+@dataclass(frozen=True)
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+
+@dataclass(frozen=True)
+class VoteMessage:
+    vote: Vote
+
+
+@dataclass(frozen=True)
+class HasVoteMessage:
+    height: int
+    round: int
+    type: SignedMsgType
+    index: int
+
+
+@dataclass(frozen=True)
+class VoteSetMaj23Message:
+    height: int
+    round: int
+    type: SignedMsgType
+    block_id: BlockID
+
+
+@dataclass(frozen=True)
+class VoteSetBitsMessage:
+    height: int
+    round: int
+    type: SignedMsgType
+    block_id: BlockID
+    votes: BitArray
+
+
+Message = (
+    NewRoundStepMessage
+    | NewValidBlockMessage
+    | ProposalMessage
+    | ProposalPOLMessage
+    | BlockPartMessage
+    | VoteMessage
+    | HasVoteMessage
+    | VoteSetMaj23Message
+    | VoteSetBitsMessage
+)
+
+
+def _encode_bits(ba: BitArray) -> bytes:
+    return pe.varint_field(1, len(ba)) + pe.bytes_field(2, ba.to_bytes())
+
+
+def _decode_bits(data: bytes) -> BitArray:
+    r = pe.Reader(data)
+    n, raw = 0, b""
+    while not r.eof():
+        f, wt = r.read_tag()
+        if f == 1:
+            n = r.read_uvarint()
+        elif f == 2:
+            raw = r.read_bytes()
+        else:
+            r.skip(wt)
+    return BitArray.from_bytes(n, raw)
+
+
+def encode_message(msg: Message) -> bytes:
+    if isinstance(msg, NewRoundStepMessage):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.round + 1)
+            + pe.varint_field(3, msg.step)
+            + pe.varint_field(4, msg.seconds_since_start_time)
+            + pe.varint_field(5, msg.last_commit_round + 1)
+        )
+        return pe.message_field(T_NEW_ROUND_STEP, body)
+    if isinstance(msg, NewValidBlockMessage):
+        total, h = msg.block_part_set_header
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.round)
+            + pe.message_field(3, pe.varint_field(1, total) + pe.bytes_field(2, h))
+            + pe.message_field(4, _encode_bits(msg.block_parts))
+            + pe.varint_field(5, 1 if msg.is_commit else 0)
+        )
+        return pe.message_field(T_NEW_VALID_BLOCK, body)
+    if isinstance(msg, ProposalMessage):
+        return pe.message_field(T_PROPOSAL, msg.proposal.encode())
+    if isinstance(msg, ProposalPOLMessage):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.proposal_pol_round)
+            + pe.message_field(3, _encode_bits(msg.proposal_pol))
+        )
+        return pe.message_field(T_PROPOSAL_POL, body)
+    if isinstance(msg, BlockPartMessage):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.round)
+            + pe.message_field(3, msg.part.encode())
+        )
+        return pe.message_field(T_BLOCK_PART, body)
+    if isinstance(msg, VoteMessage):
+        return pe.message_field(T_VOTE, msg.vote.encode())
+    if isinstance(msg, HasVoteMessage):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.round)
+            + pe.varint_field(3, int(msg.type))
+            + pe.varint_field(4, msg.index + 1)
+        )
+        return pe.message_field(T_HAS_VOTE, body)
+    if isinstance(msg, VoteSetMaj23Message):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.round)
+            + pe.varint_field(3, int(msg.type))
+            + pe.message_field(4, msg.block_id.encode())
+        )
+        return pe.message_field(T_VOTE_SET_MAJ23, body)
+    if isinstance(msg, VoteSetBitsMessage):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.round)
+            + pe.varint_field(3, int(msg.type))
+            + pe.message_field(4, msg.block_id.encode())
+            + pe.message_field(5, _encode_bits(msg.votes))
+        )
+        return pe.message_field(T_VOTE_SET_BITS, body)
+    raise TypeError(f"unknown consensus message {type(msg)}")
+
+
+def decode_message(data: bytes) -> Message:
+    r = pe.Reader(data)
+    f, wt = r.read_tag()
+    body = r.read_bytes()
+    if f == T_NEW_ROUND_STEP:
+        br = pe.Reader(body)
+        kw = dict(height=0, round=-1, step=0, seconds_since_start_time=0, last_commit_round=-1)
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                kw["height"] = br.read_uvarint()
+            elif bf == 2:
+                kw["round"] = br.read_uvarint() - 1
+            elif bf == 3:
+                kw["step"] = br.read_uvarint()
+            elif bf == 4:
+                kw["seconds_since_start_time"] = br.read_uvarint()
+            elif bf == 5:
+                kw["last_commit_round"] = br.read_uvarint() - 1
+            else:
+                br.skip(bwt)
+        return NewRoundStepMessage(**kw)
+    if f == T_NEW_VALID_BLOCK:
+        br = pe.Reader(body)
+        height = round_ = 0
+        total, h = 0, b""
+        bits = BitArray(0)
+        is_commit = False
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            elif bf == 2:
+                round_ = br.read_uvarint()
+            elif bf == 3:
+                hr = pe.Reader(br.read_bytes())
+                while not hr.eof():
+                    hf, hwt = hr.read_tag()
+                    if hf == 1:
+                        total = hr.read_uvarint()
+                    elif hf == 2:
+                        h = hr.read_bytes()
+                    else:
+                        hr.skip(hwt)
+            elif bf == 4:
+                bits = _decode_bits(br.read_bytes())
+            elif bf == 5:
+                is_commit = br.read_uvarint() == 1
+            else:
+                br.skip(bwt)
+        return NewValidBlockMessage(height, round_, (total, h), bits, is_commit)
+    if f == T_PROPOSAL:
+        return ProposalMessage(Proposal.decode(body))
+    if f == T_PROPOSAL_POL:
+        br = pe.Reader(body)
+        height = pol_round = 0
+        bits = BitArray(0)
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            elif bf == 2:
+                pol_round = br.read_uvarint()
+            elif bf == 3:
+                bits = _decode_bits(br.read_bytes())
+            else:
+                br.skip(bwt)
+        return ProposalPOLMessage(height, pol_round, bits)
+    if f == T_BLOCK_PART:
+        br = pe.Reader(body)
+        height = round_ = 0
+        part = None
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            elif bf == 2:
+                round_ = br.read_uvarint()
+            elif bf == 3:
+                part = Part.decode(br.read_bytes())
+            else:
+                br.skip(bwt)
+        return BlockPartMessage(height, round_, part)
+    if f == T_VOTE:
+        return VoteMessage(Vote.decode(body))
+    if f == T_HAS_VOTE:
+        br = pe.Reader(body)
+        kw = dict(height=0, round=0, type=SignedMsgType.UNKNOWN, index=-1)
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                kw["height"] = br.read_uvarint()
+            elif bf == 2:
+                kw["round"] = br.read_uvarint()
+            elif bf == 3:
+                kw["type"] = SignedMsgType(br.read_uvarint())
+            elif bf == 4:
+                kw["index"] = br.read_uvarint() - 1
+            else:
+                br.skip(bwt)
+        return HasVoteMessage(**kw)
+    if f in (T_VOTE_SET_MAJ23, T_VOTE_SET_BITS):
+        br = pe.Reader(body)
+        height = round_ = 0
+        type_ = SignedMsgType.UNKNOWN
+        bid = NIL_BLOCK_ID
+        bits = BitArray(0)
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            elif bf == 2:
+                round_ = br.read_uvarint()
+            elif bf == 3:
+                type_ = SignedMsgType(br.read_uvarint())
+            elif bf == 4:
+                bid = BlockID.decode(br.read_bytes())
+            elif bf == 5:
+                bits = _decode_bits(br.read_bytes())
+            else:
+                br.skip(bwt)
+        if f == T_VOTE_SET_MAJ23:
+            return VoteSetMaj23Message(height, round_, type_, bid)
+        return VoteSetBitsMessage(height, round_, type_, bid, bits)
+    raise ValueError(f"unknown consensus message tag {f}")
+
+
+# -- WAL message wrapping -------------------------------------------------
+
+
+def encode_wal_message(msg, peer_id: str = "") -> bytes:
+    """MsgInfo{msg, peer} or TimeoutInfo → WAL payload (reference
+    wal.go WALMessage union)."""
+    if isinstance(msg, TimeoutInfo):
+        body = (
+            pe.varint_field(1, msg.duration_ns)
+            + pe.varint_field(2, msg.height)
+            + pe.varint_field(3, msg.round)
+            + pe.varint_field(4, int(msg.step))
+        )
+        return pe.message_field(W_TIMEOUT, body)
+    body = pe.bytes_field(1, encode_message(msg)) + pe.string_field(2, peer_id)
+    return pe.message_field(W_MSG_INFO, body)
+
+
+def decode_wal_message(data: bytes):
+    """Returns (msg, peer_id) for MsgInfo or (TimeoutInfo, None)."""
+    r = pe.Reader(data)
+    f, wt = r.read_tag()
+    body = r.read_bytes()
+    if f == W_TIMEOUT:
+        br = pe.Reader(body)
+        dur = height = round_ = 0
+        step = RoundStep.NEW_HEIGHT
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                dur = br.read_uvarint()
+            elif bf == 2:
+                height = br.read_uvarint()
+            elif bf == 3:
+                round_ = br.read_uvarint()
+            elif bf == 4:
+                step = RoundStep(br.read_uvarint())
+            else:
+                br.skip(bwt)
+        return TimeoutInfo(dur, height, round_, step), None
+    br = pe.Reader(body)
+    raw, peer = b"", ""
+    while not br.eof():
+        bf, bwt = br.read_tag()
+        if bf == 1:
+            raw = br.read_bytes()
+        elif bf == 2:
+            peer = br.read_string()
+        else:
+            br.skip(bwt)
+    return decode_message(raw), peer

@@ -1,0 +1,994 @@
+"""The consensus state machine (reference internal/consensus/state.go:79).
+
+Single-threaded by construction, exactly like the reference's
+`receiveRoutine` (state.go:759): ALL state transitions happen on one
+asyncio task consuming three inputs — peer messages, internal (self-
+originated) messages, and timer ticks. Every input is WAL-written before
+it is acted on, so a crash at any point replays deterministically
+(`catchup_replay`, reference replay.go:94).
+
+Step functions mirror the reference one-for-one:
+  enter_new_round (state.go:1010) → enter_propose (:1092)
+  → enter_prevote (:1270) → enter_prevote_wait → enter_precommit (:1366)
+  → enter_precommit_wait → enter_commit (:1520) → finalize_commit (:1611)
+
+The Tendermint locking rules live in `_add_vote` (prevote polka ⇒
+valid-block update + possible unlock, state.go:2095-2160) and
+`enter_precommit` (lock on polka, state.go:1412-1480).
+
+Outbound messages (proposal, block parts, votes) are pushed through
+`broadcast_hook`, which the consensus reactor (or an in-process test
+network) installs; the SM never talks to the network directly.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Callable
+
+from ..config import ConsensusConfig
+from ..evidence import EvidencePoolI, NopEvidencePool
+from ..libs.service import Service
+from ..privval import PrivValidator
+from ..state.execution import BlockExecutor
+from ..state.state import State
+from ..state.validation import BlockValidationError
+from ..store.blockstore import BlockStore
+from ..types.block import Block, BlockID, NIL_BLOCK_ID
+from ..types.events import (
+    EventBus,
+    EventDataCompleteProposal,
+    EventDataVote,
+)
+from ..types.keys import SignedMsgType
+from ..types.part_set import Part, PartSet
+from ..types.vote import Proposal, Vote
+from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
+from . import messages as m
+from .ticker import TimeoutInfo, TimeoutTicker
+from .types import HeightVoteSet, RoundState, RoundStep
+from .wal import WAL, KIND_MESSAGE
+
+
+def _now_ns() -> int:
+    return time.time_ns()
+
+
+@dataclass(frozen=True)
+class MsgInfo:
+    msg: object
+    peer_id: str = ""  # "" = internally generated
+
+
+class ConsensusError(RuntimeError):
+    pass
+
+
+class ConsensusState(Service):
+    def __init__(
+        self,
+        config: ConsensusConfig,
+        state: State,
+        block_exec: BlockExecutor,
+        block_store: BlockStore,
+        *,
+        priv_validator: PrivValidator | None = None,
+        evidence_pool: EvidencePoolI | None = None,
+        wal: WAL | None = None,
+        event_bus: EventBus | None = None,
+        logger: logging.Logger | None = None,
+    ):
+        super().__init__("consensus", logger)
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.priv_validator = priv_validator
+        self.evidence_pool = evidence_pool or NopEvidencePool()
+        self.wal = wal
+        self.event_bus = event_bus
+
+        self.rs = RoundState()
+        self.state: State | None = None
+
+        # one merged input queue for peer msgs and timer ticks — the
+        # reference's select{} across three channels is pseudo-random among
+        # ready cases, so a single FIFO is an equivalent (and fully
+        # cancellable) discipline; internal msgs are handled synchronously
+        # in _send_internal
+        self.msg_queue: asyncio.Queue[MsgInfo | TimeoutInfo] = asyncio.Queue(
+            maxsize=2000
+        )
+        self.ticker = TimeoutTicker(self.msg_queue)
+
+        # reactor hooks: called with consensus Messages to gossip out
+        self.broadcast_hook: Callable[[object], None] | None = None
+        # step-change hook (reactor broadcasts NewRoundStep from it)
+        self.step_hook: Callable[[RoundState], None] | None = None
+
+        self._replay_mode = False
+        self._n_started_height = 0
+        self._wake = asyncio.Event()  # new-height nudge for tests
+        self._decided: asyncio.Event = asyncio.Event()
+
+        self.update_to_state(state)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    async def on_start(self) -> None:
+        if self.wal is not None:
+            self.catchup_replay()
+        self.spawn(self._receive_routine(), name="cs.receive")
+        # kick off the first height
+        self._schedule_timeout(
+            self.config.timeout_commit_ns, self.rs.height, 0, RoundStep.NEW_HEIGHT
+        )
+
+    async def on_stop(self) -> None:
+        self.ticker.stop()
+        if self.wal is not None:
+            self.wal.close()
+
+    # ------------------------------------------------------------------
+    # public input
+    # ------------------------------------------------------------------
+
+    async def add_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
+        await self.msg_queue.put(MsgInfo(m.ProposalMessage(proposal), peer_id))
+
+    async def add_block_part(
+        self, height: int, round_: int, part: Part, peer_id: str = ""
+    ) -> None:
+        await self.msg_queue.put(
+            MsgInfo(m.BlockPartMessage(height, round_, part), peer_id)
+        )
+
+    async def add_vote(self, vote: Vote, peer_id: str = "") -> None:
+        await self.msg_queue.put(MsgInfo(m.VoteMessage(vote), peer_id))
+
+    def get_round_state(self) -> RoundState:
+        return self.rs
+
+    # ------------------------------------------------------------------
+    # state setup
+    # ------------------------------------------------------------------
+
+    def update_to_state(self, state: State) -> None:
+        """Prepare the round state for height state.last_block_height+1
+        (reference updateToState state.go:xxx after finalize)."""
+        if (
+            self.rs.commit_round > -1
+            and 0 < self.rs.height <= state.last_block_height
+        ):
+            # finished a height; sanity check
+            if self.rs.height != state.last_block_height:
+                raise ConsensusError(
+                    f"updateToState expected height {self.rs.height}, "
+                    f"state at {state.last_block_height}"
+                )
+        height = state.last_block_height + 1
+        if height == 1:
+            last_precommits = None
+        else:
+            if self.rs.commit_round > -1 and self.rs.votes is not None:
+                last_precommits = self.rs.votes.precommits(self.rs.commit_round)
+                if last_precommits is None or not last_precommits.has_two_thirds_majority():
+                    raise ConsensusError("commit round has no +2/3 precommits")
+            else:
+                last_precommits = self.rs.last_commit  # restart path
+        validators = state.validators.copy()
+
+        rs = self.rs
+        rs.height = height
+        rs.round = 0
+        rs.step = RoundStep.NEW_HEIGHT
+        if rs.commit_time_ns == 0:
+            rs.start_time_ns = self.config.commit_time_ns(_now_ns())
+        else:
+            rs.start_time_ns = self.config.commit_time_ns(rs.commit_time_ns)
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        rs.votes = HeightVoteSet(state.chain_id, height, validators)
+        rs.commit_round = -1
+        rs.last_commit = last_precommits
+        rs.last_validators = state.last_validators.copy() if state.last_validators else None
+        rs.triggered_timeout_precommit = False
+        self.state = state
+        self._new_step()
+
+    def _new_step(self) -> None:
+        if self.step_hook is not None:
+            self.step_hook(self.rs)
+        if self.event_bus is not None:
+            self.event_bus.publish_new_round_step(self.rs.round_state_event())
+
+    # ------------------------------------------------------------------
+    # WAL replay
+    # ------------------------------------------------------------------
+
+    def catchup_replay(self) -> None:
+        """Replay WAL messages for the in-progress height (reference
+        replay.go:94 catchupReplay)."""
+        cs_height = self.rs.height
+        recs = self.wal.search_for_end_height(cs_height - 1)
+        if recs is None:
+            if cs_height == self.state.initial_height:
+                recs = []
+            else:
+                raise ConsensusError(
+                    f"WAL has no end-height record for {cs_height - 1}"
+                )
+        self._replay_mode = True
+        try:
+            for rec in recs:
+                if rec.kind != KIND_MESSAGE:
+                    continue
+                msg, peer = m.decode_wal_message(rec.data)
+                if isinstance(msg, TimeoutInfo):
+                    self._handle_timeout(msg)
+                else:
+                    self._handle_msg(MsgInfo(msg, peer or ""))
+        finally:
+            self._replay_mode = False
+        self.logger.info("WAL replay done at height %d", cs_height)
+
+    # ------------------------------------------------------------------
+    # the single-threaded event loop
+    # ------------------------------------------------------------------
+
+    async def _receive_routine(self) -> None:
+        while True:
+            item = await self.msg_queue.get()
+            try:
+                if isinstance(item, TimeoutInfo):
+                    self._wal_write(m.encode_wal_message(item), sync=True)
+                    self._handle_timeout(item)
+                else:
+                    # peer msgs: buffered write (group flush); internal
+                    # msgs are WAL-synced in _send_internal (reference
+                    # state.go:782-806)
+                    self._wal_write(
+                        m.encode_wal_message(item.msg, item.peer_id), sync=False
+                    )
+                    self._handle_msg(item)
+            except ConflictingVoteError as e:
+                self.evidence_pool.report_conflicting_votes(e.existing, e.new)
+                self.logger.info(
+                    "found conflicting vote, sent to evidence pool: %s", e.new
+                )
+            except (VoteSetError, BlockValidationError, ValueError) as e:
+                self.logger.info("dropped invalid consensus input: %r", e)
+            # run any async follow-up (finalize) scheduled by handlers
+            await self._drain_finalize()
+
+    def _wal_write(self, payload: bytes, *, sync: bool) -> None:
+        if self.wal is None or self._replay_mode:
+            return
+        if sync:
+            self.wal.write_sync(payload)
+        else:
+            self.wal.write(payload)
+
+    _finalize_pending: bool = False
+
+    async def _drain_finalize(self) -> None:
+        while self._finalize_pending:
+            self._finalize_pending = False
+            await self._finalize_commit()
+
+    # ------------------------------------------------------------------
+    # message dispatch (sync — mutations happen inline; the only async
+    # part, ApplyBlock, is deferred via _finalize_pending)
+    # ------------------------------------------------------------------
+
+    def _handle_msg(self, mi: MsgInfo) -> None:
+        msg = mi.msg
+        if isinstance(msg, m.ProposalMessage):
+            self._set_proposal(msg.proposal)
+        elif isinstance(msg, m.BlockPartMessage):
+            self._add_proposal_block_part(msg, mi.peer_id)
+        elif isinstance(msg, m.VoteMessage):
+            self._try_add_vote(msg.vote, mi.peer_id)
+        else:
+            self.logger.debug("ignoring message %s", type(msg).__name__)
+
+    def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """Reference handleTimeout state.go:907."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or (
+            ti.round == rs.round and ti.step < rs.step
+        ):
+            return
+        if ti.step == RoundStep.NEW_HEIGHT:
+            self._enter_new_round(ti.height, 0)
+        elif ti.step == RoundStep.NEW_ROUND:
+            self._enter_propose(ti.height, 0)
+        elif ti.step == RoundStep.PROPOSE:
+            if self.event_bus is not None:
+                self.event_bus.publish_timeout_propose(rs.round_state_event())
+            self._enter_prevote(ti.height, ti.round)
+        elif ti.step == RoundStep.PREVOTE_WAIT:
+            if self.event_bus is not None:
+                self.event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+        elif ti.step == RoundStep.PRECOMMIT_WAIT:
+            if self.event_bus is not None:
+                self.event_bus.publish_timeout_wait(rs.round_state_event())
+            self._enter_precommit(ti.height, ti.round)
+            self._enter_new_round(ti.height, ti.round + 1)
+        else:
+            raise ConsensusError(f"invalid timeout step {ti.step}")
+
+    def _schedule_timeout(
+        self, duration_ns: int, height: int, round_: int, step: RoundStep
+    ) -> None:
+        # note: scheduling stays live during WAL replay (like the
+        # reference's catchupReplay driving the real timeoutTicker) so a
+        # node restarted mid-round has its step timeout armed
+        self.ticker.schedule(TimeoutInfo(duration_ns, height, round_, step))
+
+    def _broadcast(self, msg) -> None:
+        if self.broadcast_hook is not None and not self._replay_mode:
+            self.broadcast_hook(msg)
+
+    def _send_internal(self, mi: MsgInfo) -> None:
+        """Internal messages loop straight back into the queue (reference
+        sendInternalMessage state.go) — but since we are single-threaded
+        we can handle them synchronously for determinism."""
+        self._wal_write(m.encode_wal_message(mi.msg, mi.peer_id), sync=True)
+        try:
+            self._handle_msg(mi)
+        except ConflictingVoteError as e:
+            self.evidence_pool.report_conflicting_votes(e.existing, e.new)
+
+    # ------------------------------------------------------------------
+    # step: NewRound
+    # ------------------------------------------------------------------
+
+    def _enter_new_round(self, height: int, round_: int) -> None:
+        """Reference enterNewRound state.go:1010."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step != RoundStep.NEW_HEIGHT
+        ):
+            return
+        self.logger.debug("enterNewRound %d/%d", height, round_)
+
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy_increment_proposer_priority(
+                round_ - rs.round
+            )
+            rs.validators = validators
+        rs.round = round_
+        rs.step = RoundStep.NEW_ROUND
+        if round_ != 0:
+            # round 0 keeps the proposal from NewHeight setup; later rounds
+            # start fresh (but keep the proposal *block* if it repropagates)
+            rs.proposal = None
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.triggered_timeout_precommit = False
+        rs.votes.set_round(round_ + 1)
+        if self.event_bus is not None:
+            self.event_bus.publish_new_round(rs.round_state_event())
+        self._new_step()
+
+        wait_for_txs = (
+            not self.config.create_empty_blocks
+            and round_ == 0
+        )
+        if wait_for_txs:
+            if self.config.create_empty_blocks_interval_ns > 0:
+                self._schedule_timeout(
+                    self.config.create_empty_blocks_interval_ns,
+                    height,
+                    round_,
+                    RoundStep.NEW_ROUND,
+                )
+        else:
+            self._enter_propose(height, round_)
+
+    # ------------------------------------------------------------------
+    # step: Propose
+    # ------------------------------------------------------------------
+
+    def _enter_propose(self, height: int, round_: int) -> None:
+        """Reference enterPropose state.go:1092."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PROPOSE
+        ):
+            return
+        self.logger.debug("enterPropose %d/%d", height, round_)
+        rs.round = round_
+        rs.step = RoundStep.PROPOSE
+        self._new_step()
+
+        self._schedule_timeout(
+            self.config.propose_timeout_ns(round_), height, round_, RoundStep.PROPOSE
+        )
+        if self._is_proposal_complete():
+            self._enter_prevote(height, round_)
+        if self._is_proposer():
+            self._decide_proposal(height, round_)
+
+    def _is_proposer(self) -> bool:
+        if self.priv_validator is None:
+            return False
+        addr = self.priv_validator.get_pub_key().address()
+        return self.rs.validators.get_proposer().address == addr
+
+    def _is_proposal_complete(self) -> bool:
+        """Reference isProposalComplete state.go:1216: need the proposal +
+        full block; if POL round set, also need that round's polka."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        prevotes = rs.votes.prevotes(rs.proposal.pol_round)
+        return prevotes is not None and prevotes.has_two_thirds_majority()
+
+    def _decide_proposal(self, height: int, round_: int) -> None:
+        """Reference defaultDecideProposal state.go:1163."""
+        if self._replay_mode:
+            return  # our own proposal is in the WAL; don't re-sign
+        rs = self.rs
+        if rs.locked_block is not None:
+            block, parts = rs.locked_block, rs.locked_block_parts
+        elif rs.valid_block is not None:
+            block, parts = rs.valid_block, rs.valid_block_parts
+        else:
+            proposer_addr = self.priv_validator.get_pub_key().address()
+            last_commit = None
+            if height > self.state.initial_height:
+                last_commit = self.block_store.load_seen_commit(height - 1)
+                if last_commit is None and rs.last_commit is not None:
+                    last_commit = rs.last_commit.make_commit()
+            try:
+                block, parts = self.block_exec.create_proposal_block(
+                    height, self.state, last_commit, proposer_addr
+                )
+            except Exception as e:
+                self.logger.error("failed to create proposal block: %r", e)
+                return
+
+        block_id = BlockID(block.hash(), parts.header)
+        proposal = Proposal(height, round_, rs.valid_round, block_id, _now_ns())
+        try:
+            proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
+        except Exception as e:
+            self.logger.error("propose step; failed signing proposal: %r", e)
+            return
+        self._send_internal(MsgInfo(m.ProposalMessage(proposal)))
+        self._broadcast(m.ProposalMessage(proposal))
+        for i in range(parts.header.total):
+            part = parts.get_part(i)
+            self._send_internal(MsgInfo(m.BlockPartMessage(height, round_, part)))
+            self._broadcast(m.BlockPartMessage(height, round_, part))
+        self.logger.info("proposed block %d/%d %s", height, round_, block_id.hash.hex()[:12])
+
+    # ------------------------------------------------------------------
+    # proposal intake
+    # ------------------------------------------------------------------
+
+    def _set_proposal(self, proposal: Proposal) -> None:
+        """Reference defaultSetProposal state.go:1821."""
+        rs = self.rs
+        if rs.proposal is not None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        proposal.validate_basic()
+        if not (-1 <= proposal.pol_round < proposal.round):
+            raise ValueError("invalid proposal POL round")
+        # verify proposer signature (state.go:1847)
+        proposer = rs.validators.get_proposer()
+        sb = proposal.sign_bytes(self.state.chain_id)
+        if not proposer.pub_key.verify_signature(sb, proposal.signature):
+            raise ValueError("invalid proposal signature")
+        rs.proposal = proposal
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header
+            )
+        self.logger.debug("received proposal %d/%d", proposal.height, proposal.round)
+
+    def _add_proposal_block_part(self, msg: m.BlockPartMessage, peer_id: str) -> bool:
+        """Reference addProposalBlockPart state.go:1863."""
+        rs = self.rs
+        if msg.height != rs.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added:
+            return False
+        if rs.proposal_block_parts.is_complete():
+            data = rs.proposal_block_parts.assemble()
+            block = Block.decode(data)
+            if (
+                rs.proposal is not None
+                and block.hash() != rs.proposal.block_id.hash
+            ):
+                raise ValueError("completed proposal block hash mismatch")
+            rs.proposal_block = block
+            self.logger.info(
+                "received complete proposal block %d %s",
+                block.header.height,
+                block.hash().hex()[:12],
+            )
+            if self.event_bus is not None:
+                self.event_bus.publish_complete_proposal(
+                    EventDataCompleteProposal(
+                        rs.height,
+                        rs.round,
+                        rs.step.name,
+                        BlockID(block.hash(), rs.proposal_block_parts.header),
+                    )
+                )
+            # update valid block if a polka already exists for it
+            prevotes = rs.votes.prevotes(rs.round)
+            maj = prevotes.two_thirds_majority() if prevotes else None
+            if (
+                maj is not None
+                and not maj.is_nil()
+                and rs.valid_round < rs.round
+                and maj.hash == block.hash()
+            ):
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+            if rs.step <= RoundStep.PROPOSE and self._is_proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+            elif rs.step == RoundStep.COMMIT:
+                self._finalize_later()
+        return True
+
+    # ------------------------------------------------------------------
+    # step: Prevote
+    # ------------------------------------------------------------------
+
+    def _enter_prevote(self, height: int, round_: int) -> None:
+        """Reference enterPrevote state.go:1270."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE
+        ):
+            return
+        self.logger.debug("enterPrevote %d/%d", height, round_)
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE
+        self._new_step()
+        self._do_prevote(height, round_)
+
+    def _do_prevote(self, height: int, round_: int) -> None:
+        """Reference defaultDoPrevote state.go:1299."""
+        rs = self.rs
+        if rs.locked_block is not None:
+            self._sign_add_vote(
+                SignedMsgType.PREVOTE,
+                BlockID(rs.locked_block.hash(), rs.locked_block_parts.header),
+            )
+            return
+        if rs.proposal_block is None:
+            self._sign_add_vote(SignedMsgType.PREVOTE, NIL_BLOCK_ID)
+            return
+        try:
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+        except (BlockValidationError, ValueError) as e:
+            self.logger.info("prevote nil: invalid proposal block: %r", e)
+            self._sign_add_vote(SignedMsgType.PREVOTE, NIL_BLOCK_ID)
+            return
+        self._sign_add_vote(
+            SignedMsgType.PREVOTE,
+            BlockID(rs.proposal_block.hash(), rs.proposal_block_parts.header),
+        )
+
+    def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PREVOTE_WAIT
+        ):
+            return
+        prevotes = rs.votes.prevotes(round_)
+        if prevotes is None or not prevotes.has_two_thirds_any():
+            raise ConsensusError("enterPrevoteWait without +2/3 prevotes")
+        rs.round = round_
+        rs.step = RoundStep.PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(
+            self.config.prevote_timeout_ns(round_),
+            height,
+            round_,
+            RoundStep.PREVOTE_WAIT,
+        )
+
+    # ------------------------------------------------------------------
+    # step: Precommit
+    # ------------------------------------------------------------------
+
+    def _enter_precommit(self, height: int, round_: int) -> None:
+        """Reference enterPrecommit state.go:1366 — the locking step."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.step >= RoundStep.PRECOMMIT
+        ):
+            return
+        self.logger.debug("enterPrecommit %d/%d", height, round_)
+        rs.round = round_
+        rs.step = RoundStep.PRECOMMIT
+        self._new_step()
+
+        prevotes = rs.votes.prevotes(round_)
+        block_id = prevotes.two_thirds_majority() if prevotes else None
+
+        if block_id is None:
+            # no polka: precommit nil (but do NOT unlock)
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, NIL_BLOCK_ID)
+            return
+
+        if self.event_bus is not None:
+            self.event_bus.publish_polka(rs.round_state_event())
+
+        if block_id.is_nil():
+            # +2/3 prevoted nil: unlock and precommit nil (state.go:1431)
+            rs.locked_round = -1
+            rs.locked_block = None
+            rs.locked_block_parts = None
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, NIL_BLOCK_ID)
+            return
+
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            # relock (state.go:1445)
+            rs.locked_round = round_
+            if self.event_bus is not None:
+                self.event_bus.publish_lock(rs.round_state_event())
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id)
+            return
+
+        if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+            # lock the proposal block (state.go:1458)
+            self.block_exec.validate_block(self.state, rs.proposal_block)
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            if self.event_bus is not None:
+                self.event_bus.publish_lock(rs.round_state_event())
+            self._sign_add_vote(SignedMsgType.PRECOMMIT, block_id)
+            return
+
+        # polka for a block we don't have: unlock, fetch it, precommit nil
+        # (state.go:1477)
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        if rs.proposal_block_parts is None or not (
+            rs.proposal_block_parts.header == block_id.part_set_header
+        ):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(block_id.part_set_header)
+        self._sign_add_vote(SignedMsgType.PRECOMMIT, NIL_BLOCK_ID)
+
+    def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or (
+            rs.round == round_ and rs.triggered_timeout_precommit
+        ):
+            return
+        precommits = rs.votes.precommits(round_)
+        if precommits is None or not precommits.has_two_thirds_any():
+            raise ConsensusError("enterPrecommitWait without +2/3 precommits")
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(
+            self.config.precommit_timeout_ns(round_),
+            height,
+            round_,
+            RoundStep.PRECOMMIT_WAIT,
+        )
+
+    # ------------------------------------------------------------------
+    # step: Commit
+    # ------------------------------------------------------------------
+
+    def _enter_commit(self, height: int, commit_round: int) -> None:
+        """Reference enterCommit state.go:1520."""
+        rs = self.rs
+        if rs.height != height or rs.step >= RoundStep.COMMIT:
+            return
+        self.logger.debug("enterCommit %d/%d", height, commit_round)
+        rs.step = RoundStep.COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time_ns = _now_ns()
+        self._new_step()
+
+        precommits = rs.votes.precommits(commit_round)
+        block_id = precommits.two_thirds_majority()
+        if block_id is None or block_id.is_nil():
+            raise ConsensusError("enterCommit without +2/3 block precommits")
+
+        # move the locked block to proposal position if it's the one
+        if rs.locked_block is not None and rs.locked_block.hash() == block_id.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+        if rs.proposal_block is None or rs.proposal_block.hash() != block_id.hash:
+            if rs.proposal_block_parts is None or not (
+                rs.proposal_block_parts.header == block_id.part_set_header
+            ):
+                # don't have the block: wait for parts
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(block_id.part_set_header)
+                return
+        self._finalize_later()
+
+    def _finalize_later(self) -> None:
+        self._finalize_pending = True
+
+    async def _finalize_commit(self) -> None:
+        """Reference finalizeCommit state.go:1611 — the only async step
+        (ApplyBlock awaits the ABCI app)."""
+        rs = self.rs
+        if rs.step != RoundStep.COMMIT:
+            return
+        height = rs.height
+        precommits = rs.votes.precommits(rs.commit_round)
+        block_id = precommits.two_thirds_majority()
+        if block_id is None or block_id.is_nil():
+            return
+        block, parts = rs.proposal_block, rs.proposal_block_parts
+        if block is None or block.hash() != block_id.hash:
+            return  # still waiting for the block
+        self.block_exec.validate_block(self.state, block)
+
+        if self.block_store.height() < height:
+            seen_commit = precommits.make_commit()
+            self.block_store.save_block(block, parts, seen_commit)
+        # height is durably decided: WAL end-height marker (the blockstore
+        # has the block; replay resumes from the next height)
+        if self.wal is not None and not self._replay_mode:
+            self.wal.write_end_height(height)
+
+        state, _ = await self.block_exec.apply_block(self.state, block_id, block)
+
+        # next height
+        rs.commit_time_ns = _now_ns()
+        self.update_to_state(state)
+        self._decided.set()
+        self._decided = asyncio.Event()
+        self._schedule_timeout(
+            max(0, rs.start_time_ns - _now_ns()),
+            rs.height,
+            0,
+            RoundStep.NEW_HEIGHT,
+        )
+        self.logger.info(
+            "committed block height=%d hash=%s txs=%d",
+            height,
+            block_id.hash.hex()[:12],
+            len(block.txs),
+        )
+
+    async def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
+        """Test helper: block until consensus commits `height`."""
+        deadline = time.monotonic() + timeout
+        while self.rs.height <= height:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"consensus stuck at height {self.rs.height} (wanted > {height})"
+                )
+            ev = self._decided
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=min(remaining, 0.5))
+            except asyncio.TimeoutError:
+                pass
+
+    # ------------------------------------------------------------------
+    # votes
+    # ------------------------------------------------------------------
+
+    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference tryAddVote state.go:1961."""
+        try:
+            return self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            if (
+                self.priv_validator is not None
+                and vote.validator_address
+                == self.priv_validator.get_pub_key().address()
+            ):
+                self.logger.error(
+                    "found conflicting vote from ourselves: %s", vote
+                )
+                return False
+            raise
+
+    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference addVote state.go:2009 — tallies the vote and drives
+        the polka/lock/commit transitions."""
+        rs = self.rs
+
+        # A precommit for the previous height (LastCommit straggler)
+        if (
+            vote.height + 1 == rs.height
+            and vote.type == SignedMsgType.PRECOMMIT
+        ):
+            if rs.step != RoundStep.NEW_HEIGHT or rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if added:
+                self._publish_vote(vote)
+                if self.config.skip_timeout_commit and rs.last_commit.has_all():
+                    self._enter_new_round(rs.height, 0)
+            return added
+
+        if vote.height != rs.height:
+            return False
+
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self._publish_vote(vote)
+        self._broadcast(
+            m.HasVoteMessage(vote.height, vote.round, vote.type, vote.validator_index)
+        )
+
+        if vote.type == SignedMsgType.PREVOTE:
+            self._handle_prevote_added(vote)
+        elif vote.type == SignedMsgType.PRECOMMIT:
+            self._handle_precommit_added(vote)
+        return True
+
+    def _publish_vote(self, vote: Vote) -> None:
+        if self.event_bus is not None:
+            self.event_bus.publish_vote(EventDataVote(vote))
+
+    def _handle_prevote_added(self, vote: Vote) -> None:
+        """state.go:2095-2186 (prevote section of addVote)."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(vote.round)
+        block_id = prevotes.two_thirds_majority()
+        if block_id is not None:
+            # unlock on a later polka for a different block (state.go:2112)
+            if (
+                rs.locked_block is not None
+                and rs.locked_round < vote.round <= rs.round
+                and rs.locked_block.hash() != block_id.hash
+            ):
+                self.logger.info("unlocking: polka for different block at round %d", vote.round)
+                rs.locked_round = -1
+                rs.locked_block = None
+                rs.locked_block_parts = None
+                if self.event_bus is not None:
+                    self.event_bus.publish_unlock(rs.round_state_event())
+            # valid-block update (state.go:2133)
+            if (
+                not block_id.is_nil()
+                and rs.valid_round < vote.round
+                and vote.round == rs.round
+            ):
+                if rs.proposal_block is not None and rs.proposal_block.hash() == block_id.hash:
+                    rs.valid_round = vote.round
+                    rs.valid_block = rs.proposal_block
+                    rs.valid_block_parts = rs.proposal_block_parts
+                else:
+                    # polka for a block we don't have yet: start collecting it
+                    rs.proposal_block = None
+                    if rs.proposal_block_parts is None or not (
+                        rs.proposal_block_parts.header == block_id.part_set_header
+                    ):
+                        rs.proposal_block_parts = PartSet(block_id.part_set_header)
+                self._broadcast(
+                    m.NewValidBlockMessage(
+                        rs.height,
+                        rs.round,
+                        (block_id.part_set_header.total, block_id.part_set_header.hash),
+                        rs.proposal_block_parts.parts_bit_array.copy(),
+                        False,
+                    )
+                )
+
+        # step transitions (the switch at state.go:2161)
+        if rs.round < vote.round and prevotes.has_two_thirds_any():
+            # round skip: +2/3 of any prevotes in a future round
+            self._enter_new_round(rs.height, vote.round)
+        elif rs.round == vote.round and rs.step >= RoundStep.PREVOTE:
+            if block_id is not None and (
+                self._is_proposal_complete() or block_id.is_nil()
+            ):
+                self._enter_precommit(rs.height, vote.round)
+            elif prevotes.has_two_thirds_any():
+                self._enter_prevote_wait(rs.height, vote.round)
+        elif (
+            rs.proposal is not None
+            and 0 <= rs.proposal.pol_round == vote.round
+        ):
+            # the proposal's POL just completed: we can now prevote
+            if self._is_proposal_complete():
+                self._enter_prevote(rs.height, rs.round)
+
+    def _handle_precommit_added(self, vote: Vote) -> None:
+        """state.go:2188-2230 (precommit section of addVote)."""
+        rs = self.rs
+        precommits = rs.votes.precommits(vote.round)
+        block_id = precommits.two_thirds_majority()
+        if block_id is not None:
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit(rs.height, vote.round)
+            if not block_id.is_nil():
+                self._enter_commit(rs.height, vote.round)
+                if self.config.skip_timeout_commit and precommits.has_all():
+                    self._enter_new_round(rs.height, 0)
+            else:
+                self._enter_precommit_wait(rs.height, vote.round)
+        elif rs.round <= vote.round and precommits.has_two_thirds_any():
+            self._enter_new_round(rs.height, vote.round)
+            self._enter_precommit_wait(rs.height, vote.round)
+
+    # ------------------------------------------------------------------
+    # vote signing
+    # ------------------------------------------------------------------
+
+    def _vote_time_ns(self) -> int:
+        """Monotonic vote time ≥ last block time + 1ms (reference
+        voteTime state.go:2237)."""
+        now = _now_ns()
+        minimum = 0
+        if self.rs.locked_block is not None:
+            minimum = self.rs.locked_block.header.time_ns + 1_000_000
+        elif self.rs.proposal_block is not None:
+            minimum = self.rs.proposal_block.header.time_ns + 1_000_000
+        return max(now, minimum)
+
+    def _sign_vote(self, type_: SignedMsgType, block_id: BlockID) -> Vote | None:
+        if self.priv_validator is None:
+            return None
+        pub = self.priv_validator.get_pub_key()
+        addr = pub.address()
+        idx, val = self.rs.validators.get_by_address(addr)
+        if val is None:
+            return None  # not a validator
+        vote = Vote(
+            type=type_,
+            height=self.rs.height,
+            round=self.rs.round,
+            block_id=block_id,
+            timestamp_ns=self._vote_time_ns(),
+            validator_address=addr,
+            validator_index=idx,
+        )
+        try:
+            return self.priv_validator.sign_vote(self.state.chain_id, vote)
+        except Exception as e:
+            self.logger.error("failed signing vote: %r", e)
+            return None
+
+    def _sign_add_vote(self, type_: SignedMsgType, block_id: BlockID) -> None:
+        """Reference signAddVote state.go:2262."""
+        if self._replay_mode:
+            return
+        if self.priv_validator is None:
+            return
+        if not self.rs.validators.has_address(
+            self.priv_validator.get_pub_key().address()
+        ):
+            return
+        vote = self._sign_vote(type_, block_id)
+        if vote is None:
+            return
+        self._send_internal(MsgInfo(m.VoteMessage(vote)))
+        self._broadcast(m.VoteMessage(vote))

@@ -48,15 +48,44 @@ def tpu_verifier_available() -> bool:
     return _tpu_available
 
 
+# Below this many signatures the TPU round-trip (host transfer + launch
+# overhead) costs more than it saves — verify on the host instead. The
+# adaptive CPU/TPU cutoff is decided at verify() time, when the batch size
+# is known (SURVEY.md §7 hard-part #2).
+MIN_TPU_BATCH = int(os.environ.get("TMTPU_MIN_TPU_BATCH", "32"))
+
+
+class AdaptiveBatchVerifier(BatchVerifier):
+    """Collects entries, then routes the whole batch to the TPU kernel if
+    it is large enough (and a backend is usable), else verifies on the
+    host. Small commits therefore never pay a device round-trip or a
+    first-call compile."""
+
+    def __init__(self):
+        self._items: list[tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.TYPE != ED25519:
+            raise ValueError("adaptive batch verifier is ed25519-only")
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        if len(self._items) >= MIN_TPU_BATCH and tpu_verifier_available():
+            from .tpu.verify import TPUBatchVerifier
+
+            target = TPUBatchVerifier()
+        else:
+            target = CPUBatchVerifier()
+        for pk, msg, sig in self._items:
+            target.add(pk, msg, sig)
+        return target.verify()
+
+
 def supports_batch_verifier(pub_key: PubKey) -> bool:
     return pub_key.TYPE == ED25519
 
 
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
-    if pub_key.TYPE == ED25519 and tpu_verifier_available():
-        from .tpu.verify import TPUBatchVerifier
-
-        return TPUBatchVerifier()
-    if supports_batch_verifier(pub_key):
-        return CPUBatchVerifier()
+    if pub_key.TYPE == ED25519:
+        return AdaptiveBatchVerifier()
     raise ValueError(f"key type {pub_key.TYPE!r} does not support batch verification")

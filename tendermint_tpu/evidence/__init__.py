@@ -21,6 +21,12 @@ class EvidencePoolI:
         """Mark committed evidence and prune expired."""
         raise NotImplementedError
 
+    def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        """Consensus saw an equivocation; buffer it until the pool can
+        stamp it with the committed height/time (reference
+        pool.go:188 ReportConflictingVotes)."""
+        raise NotImplementedError
+
 
 class NopEvidencePool(EvidencePoolI):
     def pending_evidence(self, max_bytes):
@@ -30,4 +36,7 @@ class NopEvidencePool(EvidencePoolI):
         pass
 
     def update(self, state, evidence):
+        pass
+
+    def report_conflicting_votes(self, vote_a, vote_b):
         pass

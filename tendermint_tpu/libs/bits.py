@@ -108,6 +108,9 @@ class BitArray:
         ba._bits[: len(data)] = data[: len(ba._bits)]
         return ba
 
+    def __len__(self) -> int:
+        return self.size
+
     def __eq__(self, other) -> bool:
         return (
             isinstance(other, BitArray)

@@ -145,6 +145,9 @@ class Reader:
         self.pos += n
         return v
 
+    def read_string(self) -> str:
+        return self.read_bytes().decode("utf-8")
+
     def skip(self, wire_type: int) -> None:
         if wire_type == WIRE_VARINT:
             self.read_uvarint()

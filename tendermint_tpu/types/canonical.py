@@ -69,6 +69,37 @@ def vote_sign_bytes(
     return pe.len_prefixed(out)
 
 
+def strip_timestamp(sign_bytes: bytes, field: int = 5) -> tuple[bytes, int]:
+    """Canonical sign-bytes with the timestamp field removed (field 5 for
+    votes, 6 for proposals); returns (stripped, timestamp_ns). Used by
+    privval to allow re-signing messages that differ only in their
+    timestamp (reference privval/file.go
+    checkVotesOnlyDifferByTimestamp)."""
+    r = pe.Reader(sign_bytes)
+    inner = pe.Reader(r.read_bytes())  # drop the length prefix
+    out = b""
+    ts_ns = 0
+    while not inner.eof():
+        start = inner.pos
+        f, wt = inner.read_tag()
+        if f == field:
+            tr = pe.Reader(inner.read_bytes())
+            seconds = nanos = 0
+            while not tr.eof():
+                tf, twt = tr.read_tag()
+                if tf == 1:
+                    seconds = tr.read_uvarint()
+                elif tf == 2:
+                    nanos = tr.read_uvarint()
+                else:
+                    tr.skip(twt)
+            ts_ns = seconds * NANOS + nanos
+            continue
+        inner.skip(wt)
+        out += inner.data[start : inner.pos]
+    return out, ts_ns
+
+
 def proposal_sign_bytes(
     chain_id: str,
     height: int,

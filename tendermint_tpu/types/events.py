@@ -24,6 +24,7 @@ EVENT_COMPLETE_PROPOSAL = "CompleteProposal"
 EVENT_VOTE = "Vote"
 EVENT_POLKA = "Polka"
 EVENT_LOCK = "Lock"
+EVENT_UNLOCK = "Unlock"
 EVENT_TIMEOUT_PROPOSE = "TimeoutPropose"
 EVENT_TIMEOUT_WAIT = "TimeoutWait"
 EVENT_BLOCK_SYNC_STATUS = "BlockSyncStatus"
@@ -170,6 +171,9 @@ class EventBus:
 
     def publish_lock(self, data: EventDataRoundState) -> None:
         self._publish(EVENT_LOCK, data)
+
+    def publish_unlock(self, data: EventDataRoundState) -> None:
+        self._publish(EVENT_UNLOCK, data)
 
     def publish_timeout_propose(self, data: EventDataRoundState) -> None:
         self._publish(EVENT_TIMEOUT_PROPOSE, data)

@@ -1,0 +1,284 @@
+"""Consensus state-machine tests (modeled on the reference's
+internal/consensus/state_test.go and replay_test.go scenarios)."""
+
+import asyncio
+import os
+import tempfile
+
+import pytest
+
+from tendermint_tpu.config import ConsensusConfig
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.harness import (
+    LocalNetwork,
+    Node,
+    fast_config,
+    make_genesis,
+)
+from tendermint_tpu.consensus.ticker import TimeoutInfo, TimeoutTicker
+from tendermint_tpu.consensus.types import HeightVoteSet, RoundStep
+from tendermint_tpu.libs.bits import BitArray
+from tendermint_tpu.privval import (
+    DoubleSignError,
+    FilePV,
+    MockPV,
+    STEP_PRECOMMIT,
+)
+from tendermint_tpu.testing import make_block_id, make_validator_set, make_vote
+from tendermint_tpu.types.keys import SignedMsgType
+from tendermint_tpu.types.vote import Vote
+
+
+# ---------------------------------------------------------------------------
+# privval
+# ---------------------------------------------------------------------------
+
+
+class TestFilePV:
+    def _mk(self, tmp):
+        return FilePV.generate(
+            os.path.join(tmp, "key.json"), os.path.join(tmp, "state.json")
+        )
+
+    def test_roundtrip(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            pv = self._mk(tmp)
+            pv2 = FilePV.load(pv.key_path, pv.state_path)
+            assert pv2.priv_key.bytes() == pv.priv_key.bytes()
+
+    def test_sign_vote_and_double_sign_guard(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            pv = self._mk(tmp)
+            bid = make_block_id(b"a")
+            vote = Vote(
+                type=SignedMsgType.PRECOMMIT,
+                height=5,
+                round=0,
+                block_id=bid,
+                timestamp_ns=1_700_000_000_000_000_000,
+                validator_address=pv.get_pub_key().address(),
+                validator_index=0,
+            )
+            signed = pv.sign_vote("c", vote)
+            assert pv.get_pub_key().verify_signature(
+                vote.sign_bytes("c"), signed.signature
+            )
+            # identical re-sign: same signature returned (crash-recovery path)
+            again = pv.sign_vote("c", vote)
+            assert again.signature == signed.signature
+            # conflicting block at same HRS: refused
+            vote_b = Vote(**{**vote.__dict__, "block_id": make_block_id(b"b")})
+            with pytest.raises(DoubleSignError):
+                pv.sign_vote("c", vote_b)
+            # differs only in timestamp: allowed — old signature AND old
+            # timestamp are returned, so the result still verifies
+            vote_ts = Vote(
+                **{**vote.__dict__, "timestamp_ns": vote.timestamp_ns + 5}
+            )
+            resigned = pv.sign_vote("c", vote_ts)
+            assert resigned.signature == signed.signature
+            assert resigned.timestamp_ns == vote.timestamp_ns
+            assert pv.get_pub_key().verify_signature(
+                resigned.sign_bytes("c"), resigned.signature
+            )
+
+    def test_guard_survives_restart(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            pv = self._mk(tmp)
+            bid = make_block_id(b"a")
+            vote = Vote(
+                type=SignedMsgType.PRECOMMIT,
+                height=7,
+                round=1,
+                block_id=bid,
+                timestamp_ns=1_700_000_000_000_000_000,
+                validator_address=pv.get_pub_key().address(),
+                validator_index=0,
+            )
+            pv.sign_vote("c", vote)
+            pv2 = FilePV.load(pv.key_path, pv.state_path)
+            assert pv2.last_sign_state.height == 7
+            assert pv2.last_sign_state.step == STEP_PRECOMMIT
+            lower = Vote(**{**vote.__dict__, "round": 0})
+            with pytest.raises(DoubleSignError):
+                pv2.sign_vote("c", lower)
+
+
+# ---------------------------------------------------------------------------
+# HeightVoteSet
+# ---------------------------------------------------------------------------
+
+
+class TestHeightVoteSet:
+    def test_rounds_and_catchup(self):
+        vals, keys = make_validator_set(4)
+        hvs = HeightVoteSet("c", 3, vals)
+        key0 = keys[vals.validators[0].address]
+        bid = make_block_id()
+        v = make_vote("c", key0, 0, 3, 5, SignedMsgType.PREVOTE, bid)
+        # round 5 not open, no peer claim → dropped silently
+        assert hvs.add_vote(v, "p1") is False
+        hvs.set_peer_maj23(5, SignedMsgType.PREVOTE, "p1")
+        assert hvs.add_vote(v, "p1") is True
+        assert hvs.prevotes(5).get_vote(0) == v
+
+    def test_pol_info(self):
+        vals, keys = make_validator_set(3)
+        hvs = HeightVoteSet("c", 1, vals)
+        hvs.set_round(1)
+        bid = make_block_id()
+        for i, val in enumerate(vals.validators):
+            v = make_vote(
+                "c", keys[val.address], i, 1, 1, SignedMsgType.PREVOTE, bid
+            )
+            assert hvs.add_vote(v)
+        r, pol_bid = hvs.pol_info()
+        assert r == 1 and pol_bid == bid
+
+
+# ---------------------------------------------------------------------------
+# TimeoutTicker
+# ---------------------------------------------------------------------------
+
+
+class TestTicker:
+    @pytest.mark.asyncio
+    async def test_fires_and_replaces(self):
+        t = TimeoutTicker()
+        t.schedule(TimeoutInfo(10_000_000, 1, 0, RoundStep.PROPOSE))
+        # newer HRS replaces
+        t.schedule(TimeoutInfo(5_000_000, 1, 1, RoundStep.PROPOSE))
+        ti = await asyncio.wait_for(t.tock.get(), 1.0)
+        assert ti.round == 1
+        # stale schedule ignored while pending
+        t.schedule(TimeoutInfo(5_000_000, 2, 0, RoundStep.PREVOTE_WAIT))
+        t.schedule(TimeoutInfo(60_000_000_000, 1, 0, RoundStep.PROPOSE))
+        ti = await asyncio.wait_for(t.tock.get(), 1.0)
+        assert ti.height == 2
+        t.stop()
+
+
+# ---------------------------------------------------------------------------
+# message codec
+# ---------------------------------------------------------------------------
+
+
+class TestMessages:
+    def test_roundtrip_all(self):
+        vals, keys = make_validator_set(2)
+        key0 = keys[vals.validators[0].address]
+        bid = make_block_id()
+        vote = make_vote("c", key0, 0, 4, 2, SignedMsgType.PRECOMMIT, bid)
+        ba = BitArray.from_indices(8, [1, 5])
+        msgs = [
+            m.NewRoundStepMessage(4, 2, 3, 17, -1),
+            m.NewValidBlockMessage(4, 2, (3, b"\x01" * 32), ba, True),
+            m.VoteMessage(vote),
+            m.HasVoteMessage(4, 2, SignedMsgType.PREVOTE, 1),
+            m.VoteSetMaj23Message(4, 2, SignedMsgType.PREVOTE, bid),
+            m.VoteSetBitsMessage(4, 2, SignedMsgType.PRECOMMIT, bid, ba),
+            m.ProposalPOLMessage(4, 1, ba),
+        ]
+        for msg in msgs:
+            assert m.decode_message(m.encode_message(msg)) == msg
+
+    def test_wal_wrapping(self):
+        ti = TimeoutInfo(1_000_000, 5, 1, RoundStep.PREVOTE_WAIT)
+        out, peer = m.decode_wal_message(m.encode_wal_message(ti))
+        assert out == ti and peer is None
+        msg = m.HasVoteMessage(9, 0, SignedMsgType.PREVOTE, 3)
+        out, peer = m.decode_wal_message(m.encode_wal_message(msg, "peer-1"))
+        assert out == msg and peer == "peer-1"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end consensus
+# ---------------------------------------------------------------------------
+
+
+class TestConsensus:
+    @pytest.mark.asyncio
+    async def test_single_validator_produces_blocks(self):
+        net = LocalNetwork(1)
+        await net.start()
+        try:
+            await net.wait_for_height(3, timeout=20)
+            node = net.nodes[0]
+            assert node.block_store.height() >= 3
+            blk = node.block_store.load_block(2)
+            assert blk is not None
+            assert blk.last_commit.height == 1
+        finally:
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_four_validators_reach_consensus(self):
+        net = LocalNetwork(4)
+        await net.start()
+        try:
+            await net.wait_for_height(3, timeout=30)
+            hashes = {n.block_store.load_block(2).hash() for n in net.nodes}
+            assert len(hashes) == 1, "nodes committed different blocks"
+            # all four validators should be signing
+            commit = net.nodes[0].block_store.load_seen_commit(2)
+            signed = sum(1 for s in commit.signatures if s.is_commit())
+            assert signed >= 3
+        finally:
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_consensus_with_txs(self):
+        net = LocalNetwork(2)
+        await net.start()
+        try:
+            await net.wait_for_height(2, timeout=20)
+        finally:
+            await net.stop()
+        # blocks were produced and committed identically
+        b1 = net.nodes[0].block_store.load_block(1)
+        b2 = net.nodes[1].block_store.load_block(1)
+        assert b1.hash() == b2.hash()
+
+    @pytest.mark.asyncio
+    async def test_one_node_down_still_commits(self):
+        """3 of 4 validators (>2/3 power) keep committing."""
+        net = LocalNetwork(4)
+        # node 3 never starts its consensus SM: simulate a down validator
+        down = net.nodes.pop(3)
+        await net.start()
+        try:
+            await net.wait_for_height(2, timeout=30)
+            commit = net.nodes[0].block_store.load_seen_commit(1)
+            signed = sum(1 for s in commit.signatures if s.is_commit())
+            assert signed == 3
+        finally:
+            await net.stop()
+
+
+class TestWALReplay:
+    @pytest.mark.asyncio
+    async def test_crash_and_resume(self):
+        """Run a single-validator chain, stop it, restart from the same
+        stores+WAL, verify it continues from the committed height."""
+        genesis, keys = make_genesis(1)
+        with tempfile.TemporaryDirectory() as wal_dir:
+            node = Node(genesis, keys[0], wal_dir=wal_dir)
+            await node.start()
+            await node.cs.wait_for_height(2, timeout=20)
+            height_before = node.block_store.height()
+            await node.stop()
+
+            # restart reusing the same stores and WAL (fresh SM)
+            node2 = Node(genesis, keys[0], wal_dir=wal_dir)
+            node2.block_store = node.block_store
+            node2.state_store = node.state_store
+            node2.app = node.app
+            from tendermint_tpu.proxy import AppConns
+
+            node2.app_conns = AppConns.local(node.app)
+            await node2.start()
+            try:
+                await node2.cs.wait_for_height(height_before + 1, timeout=20)
+                assert node2.block_store.height() > height_before
+            finally:
+                await node2.stop()
