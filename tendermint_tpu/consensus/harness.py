@@ -11,11 +11,13 @@ import logging
 import tempfile
 
 from ..abci.kvstore import KVStoreApp
-from ..config import ConsensusConfig
+from ..config import ConsensusConfig, MempoolConfig
 from ..consensus import messages as m
 from ..consensus.replay import Handshaker
 from ..consensus.state import ConsensusState
 from ..consensus.wal import WAL
+from ..evidence.pool import EvidencePool
+from ..mempool.pool import PriorityMempool
 from ..privval import MockPV
 from ..proxy import AppConns
 from ..state.execution import BlockExecutor
@@ -79,6 +81,8 @@ class Node:
         self.event_bus = EventBus()
         self.priv_val = MockPV(priv_key) if priv_key is not None else None
         self.wal = WAL(wal_dir or tempfile.mkdtemp(prefix="cswal-"))
+        self.mempool: PriorityMempool | None = None
+        self.evidence_pool: EvidencePool | None = None
         self.cs: ConsensusState | None = None
 
     async def start(self) -> None:
@@ -90,9 +94,17 @@ class Node:
         )
         state = await handshaker.handshake(self.app_conns)
         self.state_store.save(state)
+        self.mempool = PriorityMempool(
+            MempoolConfig(), self.app_conns.mempool, height=state.last_block_height
+        )
+        self.evidence_pool = EvidencePool(
+            MemDB(), self.state_store, self.block_store
+        )
         block_exec = BlockExecutor(
             self.state_store,
             self.app_conns.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
             block_store=self.block_store,
             event_bus=self.event_bus,
         )
@@ -102,6 +114,7 @@ class Node:
             block_exec,
             self.block_store,
             priv_validator=self.priv_val,
+            evidence_pool=self.evidence_pool,
             wal=self.wal,
             event_bus=self.event_bus,
         )

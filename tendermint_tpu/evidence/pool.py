@@ -1,0 +1,213 @@
+"""Evidence pool (reference internal/evidence/pool.go:30).
+
+Evidence lives in two DB buckets — pending (verified, awaiting block
+inclusion) and committed (markers to prevent re-submission). Conflicting
+votes reported by consensus are buffered until `update` runs for the
+height that committed them, when the pool can stamp the evidence with
+that block's time and validator power (reference
+processConsensusBuffer pool.go:512). Expiry follows the consensus
+params' max_age_num_blocks AND max_age_duration (both must pass,
+reference pool.go:61 isExpired)."""
+
+from __future__ import annotations
+
+import logging
+
+from ..store.db import DB
+from ..types.evidence import (
+    DuplicateVoteEvidence,
+    decode_evidence,
+)
+from ..types.keys import SignedMsgType
+from . import EvidencePoolI
+
+_PENDING = b"evp/"
+_COMMITTED = b"evc/"
+
+
+def _key(prefix: bytes, height: int, hash_: bytes) -> bytes:
+    return prefix + height.to_bytes(8, "big") + hash_
+
+
+class EvidenceError(ValueError):
+    pass
+
+
+class EvidencePool(EvidencePoolI):
+    def __init__(
+        self,
+        db: DB,
+        state_store,
+        block_store,
+        *,
+        logger: logging.Logger | None = None,
+    ):
+        self.db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.logger = logger or logging.getLogger("evidence")
+        self._consensus_buffer: list[tuple] = []  # (vote_a, vote_b) pairs
+        # cached tip, advanced by update()
+        self.state = state_store.load()
+
+    # -- intake ----------------------------------------------------------
+
+    def add_evidence(self, ev) -> None:
+        """Verify and persist gossiped/RPC-submitted evidence (reference
+        pool.go:137 AddEvidence)."""
+        if self._is_pending(ev):
+            return
+        if self._is_committed(ev):
+            return
+        self.verify(ev)
+        self._add_pending(ev)
+        self.logger.info("added evidence height=%d hash=%s", ev.height, ev.hash().hex()[:12])
+
+    def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        self._consensus_buffer.append((vote_a, vote_b))
+
+    # -- verification ----------------------------------------------------
+
+    def verify(self, ev) -> None:
+        """Full verification against historical state (reference
+        verify.go:24 verify)."""
+        if self.state is None:
+            raise EvidenceError("evidence pool has no state")
+        state = self.state
+        height = ev.height
+        if height > state.last_block_height:
+            raise EvidenceError("evidence from the future")
+        # expiry window: BOTH dimensions must be exceeded to expire
+        params = state.consensus_params.evidence
+        age_blocks = state.last_block_height - height
+        meta = self.block_store.load_block_meta(height)
+        if meta is None:
+            raise EvidenceError(f"no block meta at evidence height {height}")
+        age_ns = state.last_block_time_ns - meta.header.time_ns
+        if (
+            age_blocks > params.max_age_num_blocks
+            and age_ns > params.max_age_duration_ns
+        ):
+            raise EvidenceError("evidence has expired")
+
+        if isinstance(ev, DuplicateVoteEvidence):
+            self._verify_duplicate_vote(ev, meta.header.time_ns)
+        else:
+            # light-client attack evidence verification arrives with the
+            # light client (reference verify.go:159)
+            raise EvidenceError(f"unsupported evidence type {type(ev).__name__}")
+
+    def _verify_duplicate_vote(self, ev: DuplicateVoteEvidence, block_time_ns: int) -> None:
+        """Reference verify.go VerifyDuplicateVote."""
+        ev.validate_basic()
+        vals = self.state_store.load_validators(ev.height)
+        if vals is None:
+            raise EvidenceError(f"no validator set at height {ev.height}")
+        idx, val = vals.get_by_address(ev.vote_a.validator_address)
+        if val is None:
+            raise EvidenceError("validator not in set at evidence height")
+        if ev.vote_a.type != SignedMsgType.PRECOMMIT and ev.vote_a.type != SignedMsgType.PREVOTE:
+            raise EvidenceError("bad vote type in evidence")
+        # power and total must match the historical set (verify.go:104)
+        if ev.validator_power != val.voting_power:
+            raise EvidenceError("evidence validator power mismatch")
+        if ev.total_voting_power != vals.total_voting_power():
+            raise EvidenceError("evidence total power mismatch")
+        if ev.timestamp_ns != block_time_ns:
+            raise EvidenceError("evidence timestamp != block time")
+        chain_id = self.state.chain_id
+        for vote in (ev.vote_a, ev.vote_b):
+            if not vote.verify(chain_id, val.pub_key):
+                raise EvidenceError("invalid signature on evidence vote")
+
+    # -- proposal / block flow ------------------------------------------
+
+    def pending_evidence(self, max_bytes: int) -> tuple[list, int]:
+        out, size = [], 0
+        for _, raw in self.db.iterate(_PENDING, _PENDING + b"\xff"):
+            ev = decode_evidence(raw)
+            sz = len(raw)
+            if size + sz > max_bytes:
+                break
+            out.append(ev)
+            size += sz
+        return out, size
+
+    def check_evidence(self, evidence: tuple) -> None:
+        """Verify all evidence in a proposed block (reference
+        pool.go:166 CheckEvidence)."""
+        seen = set()
+        for ev in evidence:
+            h = ev.hash()
+            if h in seen:
+                raise EvidenceError("duplicate evidence in block")
+            seen.add(h)
+            if self._is_committed(ev):
+                raise EvidenceError("evidence already committed")
+            if not self._is_pending(ev):
+                self.verify(ev)
+
+    def update(self, state, evidence: tuple) -> None:
+        """Block committed: mark its evidence committed, convert buffered
+        consensus equivocations, prune expired (reference pool.go Update)."""
+        self.state = state
+        for ev in evidence:
+            self._mark_committed(ev)
+        self._process_consensus_buffer(state)
+        self._prune(state)
+
+    def _process_consensus_buffer(self, state) -> None:
+        buf, self._consensus_buffer = self._consensus_buffer, []
+        for vote_a, vote_b in buf:
+            try:
+                vals = self.state_store.load_validators(vote_a.height)
+                meta = self.block_store.load_block_meta(vote_a.height)
+                if vals is None or meta is None:
+                    # too old or not yet committed; re-buffer if plausible
+                    if vote_a.height > state.last_block_height:
+                        self._consensus_buffer.append((vote_a, vote_b))
+                    continue
+                ev = DuplicateVoteEvidence.from_votes(
+                    vote_a, vote_b, meta.header.time_ns, vals
+                )
+                if not self._is_pending(ev) and not self._is_committed(ev):
+                    self.verify(ev)
+                    self._add_pending(ev)
+                    self.logger.info(
+                        "equivocation evidence from consensus height=%d val=%s",
+                        ev.height,
+                        ev.vote_a.validator_address.hex()[:12],
+                    )
+            except Exception as e:
+                self.logger.error("failed to build consensus evidence: %r", e)
+
+    def _prune(self, state) -> None:
+        params = state.consensus_params.evidence
+        for key, raw in list(self.db.iterate(_PENDING, _PENDING + b"\xff")):
+            ev = decode_evidence(raw)
+            age_blocks = state.last_block_height - ev.height
+            meta = self.block_store.load_block_meta(ev.height)
+            expired_time = True
+            if meta is not None:
+                expired_time = (
+                    state.last_block_time_ns - meta.header.time_ns
+                    > params.max_age_duration_ns
+                )
+            if age_blocks > params.max_age_num_blocks and expired_time:
+                self.db.delete(key)
+                self.logger.debug("pruned expired evidence at height %d", ev.height)
+
+    # -- storage helpers -------------------------------------------------
+
+    def _add_pending(self, ev) -> None:
+        self.db.set(_key(_PENDING, ev.height, ev.hash()), ev.encode())
+
+    def _mark_committed(self, ev) -> None:
+        self.db.delete(_key(_PENDING, ev.height, ev.hash()))
+        self.db.set(_key(_COMMITTED, ev.height, ev.hash()), b"\x01")
+
+    def _is_pending(self, ev) -> bool:
+        return self.db.has(_key(_PENDING, ev.height, ev.hash()))
+
+    def _is_committed(self, ev) -> bool:
+        return self.db.has(_key(_COMMITTED, ev.height, ev.hash()))

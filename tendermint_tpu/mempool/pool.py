@@ -1,0 +1,250 @@
+"""Priority mempool (reference internal/mempool/v1/mempool.go:30 — the
+default mempool version, config/config.go:852) plus the LRU tx cache
+(reference internal/mempool/cache.go).
+
+Transactions are admitted via ABCI CheckTx on the mempool connection and
+held in (priority DESC, arrival ASC) order; `reap_max_bytes_max_gas`
+takes the highest-priority prefix that fits the block budget, and
+`update` removes committed txs and optionally re-CheckTxs the remainder
+(reference v1/mempool.go Update/recheckTxs). When full, the lowest-
+priority resident tx is evicted if the newcomer outranks it
+(v1/mempool.go:232 canAddTx / eviction)."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..abci import types as abci
+from ..abci.client import Client
+from ..config import MempoolConfig
+from ..crypto.hashes import sha256
+from . import Mempool
+
+
+class TxCache:
+    """Fixed-size LRU of tx hashes (reference mempool/cache.go LRUTxCache)."""
+
+    def __init__(self, size: int):
+        self.size = size
+        self._map: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, tx: bytes) -> bool:
+        """Returns False if already present (and refreshes recency)."""
+        key = sha256(tx)
+        if key in self._map:
+            self._map.move_to_end(key)
+            return False
+        self._map[key] = None
+        if len(self._map) > self.size:
+            self._map.popitem(last=False)
+        return True
+
+    def remove(self, tx: bytes) -> None:
+        self._map.pop(sha256(tx), None)
+
+    def has(self, tx: bytes) -> bool:
+        return sha256(tx) in self._map
+
+    def reset(self) -> None:
+        self._map.clear()
+
+
+class TxRejectedError(ValueError):
+    def __init__(self, code: int, log: str):
+        super().__init__(f"tx rejected: code={code} log={log!r}")
+        self.code = code
+        self.log = log
+
+
+class TxInCacheError(ValueError):
+    pass
+
+
+class MempoolFullError(ValueError):
+    pass
+
+
+@dataclass
+class WrappedTx:
+    tx: bytes
+    hash: bytes
+    height: int  # height at admission
+    priority: int
+    gas_wanted: int
+    sender: str
+    seq: int  # arrival order (FIFO tie-break)
+    time_ns: int = 0
+    peers: set[str] = field(default_factory=set)
+
+    def sort_key(self):
+        return (-self.priority, self.seq)
+
+
+class PriorityMempool(Mempool):
+    def __init__(
+        self,
+        config: MempoolConfig,
+        app: Client,
+        *,
+        height: int = 0,
+        logger: logging.Logger | None = None,
+    ):
+        self.config = config
+        self.app = app
+        self.height = height
+        self.logger = logger or logging.getLogger("mempool")
+        self.cache = TxCache(config.cache_size)
+        self._txs: dict[bytes, WrappedTx] = {}  # hash -> wtx
+        self._bytes = 0
+        self._seq = itertools.count()
+        self._lock = asyncio.Lock()
+        # set when txs are available; consensus wait-for-txs hook
+        self._txs_available: asyncio.Event = asyncio.Event()
+        self.notified_txs_available = False
+
+    # -- admission -------------------------------------------------------
+
+    async def check_tx(self, tx: bytes, sender: str = "") -> None:
+        if len(tx) > self.config.max_tx_bytes:
+            raise TxRejectedError(0, f"tx too large ({len(tx)} bytes)")
+        if not self.cache.push(tx):
+            # seen before: record the extra gossip sender, reject
+            wtx = self._txs.get(sha256(tx))
+            if wtx is not None and sender:
+                wtx.peers.add(sender)
+            raise TxInCacheError("tx already in cache")
+        res = await self.app.check_tx(abci.RequestCheckTx(tx))
+        if not res.is_ok():
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(tx)
+            raise TxRejectedError(res.code, res.log)
+        wtx = WrappedTx(
+            tx=tx,
+            hash=sha256(tx),
+            height=self.height,
+            priority=res.priority,
+            gas_wanted=res.gas_wanted,
+            sender=res.sender or sender,
+            seq=next(self._seq),
+        )
+        self._insert(wtx)
+
+    def _insert(self, wtx: WrappedTx) -> None:
+        if wtx.hash in self._txs:
+            return
+        while (
+            len(self._txs) >= self.config.size
+            or self._bytes + len(wtx.tx) > self.config.max_txs_bytes
+        ):
+            victim = max(self._txs.values(), key=lambda w: w.sort_key())
+            if victim.sort_key() <= wtx.sort_key():
+                # newcomer doesn't outrank the worst resident: reject
+                self.cache.remove(wtx.tx)
+                raise MempoolFullError(
+                    f"mempool full ({len(self._txs)} txs, {self._bytes} bytes)"
+                )
+            self._remove(victim.hash, remove_from_cache=True)
+            self.logger.debug("evicted tx %s", victim.hash.hex()[:12])
+        self._txs[wtx.hash] = wtx
+        self._bytes += len(wtx.tx)
+        if not self._txs_available.is_set():
+            self._txs_available.set()
+
+    def _remove(self, hash_: bytes, *, remove_from_cache: bool) -> None:
+        wtx = self._txs.pop(hash_, None)
+        if wtx is None:
+            return
+        self._bytes -= len(wtx.tx)
+        if remove_from_cache:
+            self.cache.remove(wtx.tx)
+
+    # -- reaping ---------------------------------------------------------
+
+    def _ordered(self) -> list[WrappedTx]:
+        return sorted(self._txs.values(), key=lambda w: w.sort_key())
+
+    def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
+        out: list[bytes] = []
+        total_bytes = total_gas = 0
+        for wtx in self._ordered():
+            nb = total_bytes + len(wtx.tx)
+            if max_bytes > -1 and nb > max_bytes:
+                break
+            ng = total_gas + wtx.gas_wanted
+            if max_gas > -1 and ng > max_gas:
+                break
+            total_bytes, total_gas = nb, ng
+            out.append(wtx.tx)
+        return out
+
+    def reap_max_txs(self, max_txs: int) -> list[bytes]:
+        txs = [w.tx for w in self._ordered()]
+        return txs if max_txs < 0 else txs[:max_txs]
+
+    # -- lifecycle -------------------------------------------------------
+
+    def lock(self):
+        return self._lock
+
+    async def update(
+        self, height: int, txs: list[bytes], results: list, *, recheck: bool = True
+    ) -> None:
+        """Remove committed txs; re-CheckTx what remains (reference
+        v1/mempool.go Update). Caller holds lock() (the executor commits
+        under it)."""
+        self.height = height
+        for i, tx in enumerate(txs):
+            committed_ok = i < len(results) and results[i].is_ok()
+            if committed_ok:
+                self.cache.push(tx)  # keep committed txs in cache
+            else:
+                self.cache.remove(tx)
+            self._remove(sha256(tx), remove_from_cache=False)
+        if recheck and self.config.recheck and self._txs:
+            await self._recheck()
+        if self.size() > 0:
+            self._txs_available.set()
+        else:
+            self._txs_available.clear()
+            self.notified_txs_available = False
+
+    async def _recheck(self) -> None:
+        """Re-run CheckTx(RECHECK) on all resident txs after a block
+        changed app state (reference recheckTxs v1/mempool.go:540)."""
+        for wtx in self._ordered():
+            res = await self.app.check_tx(
+                abci.RequestCheckTx(wtx.tx, abci.CheckTxType.RECHECK)
+            )
+            if not res.is_ok():
+                self._remove(
+                    wtx.hash,
+                    remove_from_cache=not self.config.keep_invalid_txs_in_cache,
+                )
+            else:
+                wtx.priority = res.priority
+
+    def size(self) -> int:
+        return len(self._txs)
+
+    def size_bytes(self) -> int:
+        return self._bytes
+
+    async def flush(self) -> None:
+        self._txs.clear()
+        self._bytes = 0
+        self.cache.reset()
+
+    # -- gossip support --------------------------------------------------
+
+    def all_entries(self) -> list[WrappedTx]:
+        return self._ordered()
+
+    def has_tx(self, hash_: bytes) -> bool:
+        return hash_ in self._txs
+
+    async def wait_for_txs(self) -> None:
+        await self._txs_available.wait()

@@ -76,7 +76,7 @@ def evidence_to_misbehavior(evidence: tuple, time_ns: int) -> tuple[abci.Misbeha
                     type="duplicate_vote",
                     validator_address=ev.vote_a.validator_address,
                     power=ev.validator_power,
-                    height=ev.height(),
+                    height=ev.height,
                     time_ns=ev.timestamp_ns,
                     total_voting_power=ev.total_voting_power,
                 )
@@ -88,7 +88,7 @@ def evidence_to_misbehavior(evidence: tuple, time_ns: int) -> tuple[abci.Misbeha
                         type="light_client_attack",
                         validator_address=addr,
                         power=power,
-                        height=ev.height(),
+                        height=ev.height,
                         time_ns=getattr(ev, "timestamp_ns", time_ns),
                         total_voting_power=getattr(ev, "total_voting_power", 0),
                     )

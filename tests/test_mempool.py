@@ -1,0 +1,150 @@
+"""Mempool tests (modeled on reference internal/mempool/v1/mempool_test.go
+and cache_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.application import BaseApplication
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.mempool.pool import (
+    MempoolFullError,
+    PriorityMempool,
+    TxCache,
+    TxInCacheError,
+    TxRejectedError,
+)
+
+
+class PriorityApp(BaseApplication):
+    """CheckTx assigns priority from the tx's leading digits; rejects txs
+    containing 'bad'; on recheck rejects txs containing 'stale'."""
+
+    def check_tx(self, req):
+        if b"bad" in req.tx:
+            return abci.ResponseCheckTx(code=1, log="bad tx")
+        if req.type == abci.CheckTxType.RECHECK and b"stale" in req.tx:
+            return abci.ResponseCheckTx(code=2, log="stale")
+        try:
+            prio = int(req.tx.split(b":")[0])
+        except ValueError:
+            prio = 0
+        return abci.ResponseCheckTx(priority=prio, gas_wanted=1)
+
+
+def make_pool(**cfg) -> PriorityMempool:
+    config = MempoolConfig(**cfg)
+    return PriorityMempool(config, LocalClient(PriorityApp()))
+
+
+class TestTxCache:
+    def test_lru_eviction(self):
+        c = TxCache(2)
+        assert c.push(b"a") and c.push(b"b")
+        assert not c.push(b"a")  # refreshes a
+        assert c.push(b"c")  # evicts b (least recent)
+        assert c.has(b"a") and c.has(b"c") and not c.has(b"b")
+        c.remove(b"a")
+        assert not c.has(b"a")
+
+
+class TestPriorityMempool:
+    @pytest.mark.asyncio
+    async def test_checktx_and_priority_order(self):
+        mp = make_pool()
+        for tx in [b"1:a", b"9:b", b"5:c"]:
+            await mp.check_tx(tx)
+        assert mp.size() == 3
+        assert mp.reap_max_txs(-1) == [b"9:b", b"5:c", b"1:a"]
+        # byte budget cuts the reap
+        assert mp.reap_max_bytes_max_gas(8, -1) == [b"9:b", b"5:c"]
+        # gas budget: each tx wants 1 gas
+        assert mp.reap_max_bytes_max_gas(-1, 2) == [b"9:b", b"5:c"]
+
+    @pytest.mark.asyncio
+    async def test_rejected_and_cached(self):
+        mp = make_pool()
+        with pytest.raises(TxRejectedError):
+            await mp.check_tx(b"bad:1")
+        # rejected tx NOT kept in cache by default → can be resubmitted
+        with pytest.raises(TxRejectedError):
+            await mp.check_tx(b"bad:1")
+        await mp.check_tx(b"3:x")
+        with pytest.raises(TxInCacheError):
+            await mp.check_tx(b"3:x")
+
+    @pytest.mark.asyncio
+    async def test_eviction_by_priority(self):
+        mp = make_pool(size=2)
+        await mp.check_tx(b"1:a")
+        await mp.check_tx(b"2:b")
+        # higher priority newcomer evicts the lowest resident
+        await mp.check_tx(b"5:c")
+        assert mp.size() == 2
+        assert mp.reap_max_txs(-1) == [b"5:c", b"2:b"]
+        # lower priority newcomer is refused
+        with pytest.raises(MempoolFullError):
+            await mp.check_tx(b"0:d")
+
+    @pytest.mark.asyncio
+    async def test_update_removes_committed_and_rechecks(self):
+        mp = make_pool()
+        await mp.check_tx(b"5:keep")
+        await mp.check_tx(b"4:stale-later")
+        await mp.check_tx(b"3:gone")
+        ok = abci.ResponseDeliverTx()
+        async with mp.lock():
+            await mp.update(2, [b"3:gone"], [ok])
+        assert mp.size() == 1  # stale-later failed recheck, gone committed
+        assert mp.reap_max_txs(-1) == [b"5:keep"]
+        # committed tx stays in cache → resubmission rejected
+        with pytest.raises(TxInCacheError):
+            await mp.check_tx(b"3:gone")
+
+    @pytest.mark.asyncio
+    async def test_tx_too_large(self):
+        mp = make_pool(max_tx_bytes=10)
+        with pytest.raises(TxRejectedError):
+            await mp.check_tx(b"1:" + b"x" * 20)
+
+    @pytest.mark.asyncio
+    async def test_wait_for_txs(self):
+        mp = make_pool()
+        waiter = asyncio.create_task(mp.wait_for_txs())
+        await asyncio.sleep(0.01)
+        assert not waiter.done()
+        await mp.check_tx(b"1:a")
+        await asyncio.wait_for(waiter, 1.0)
+
+
+class TestMempoolThroughConsensus:
+    @pytest.mark.asyncio
+    async def test_txs_get_committed(self):
+        """Txs admitted to any node's mempool appear in committed blocks
+        and are removed from the mempool afterwards."""
+        from tendermint_tpu.consensus.harness import LocalNetwork
+
+        net = LocalNetwork(2)
+        await net.start()
+        try:
+            for node in net.nodes:
+                await node.mempool.check_tx(b"k1=v1")
+                # same tx on both nodes: in-cache on neither is an error here
+            h0 = net.nodes[0].cs.rs.height
+            await net.wait_for_height(h0 + 2, timeout=30)
+            committed = []
+            for h in range(1, net.nodes[0].block_store.height() + 1):
+                blk = net.nodes[0].block_store.load_block(h)
+                if blk:
+                    committed.extend(blk.txs)
+            assert b"k1=v1" in committed
+            assert all(n.mempool.size() == 0 for n in net.nodes)
+            # the app executed it: query returns the value
+            from tendermint_tpu.abci import types as abci
+
+            res = net.nodes[0].app.query(abci.RequestQuery(data=b"k1"))
+            assert res.value == b"v1"
+        finally:
+            await net.stop()
