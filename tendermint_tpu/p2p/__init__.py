@@ -1,0 +1,26 @@
+"""P2P stack — the distributed communication backend (reference
+internal/p2p/; SURVEY.md §5 "Distributed communication backend").
+
+Node-to-node BFT traffic is host-side networking and stays a faithful
+rebuild of the reference's Router/Channel/Transport semantics: reactors
+hold `Channel` handles; a `Router` moves `Envelope`s between per-peer
+connections and per-reactor channels; `Transport` abstracts the wire
+(in-memory for tests, TCP+secret-connection for production)."""
+
+from .types import (
+    Envelope,
+    NodeAddress,
+    NodeID,
+    NodeInfo,
+    PeerError,
+    node_id_from_pubkey,
+)
+
+__all__ = [
+    "Envelope",
+    "NodeAddress",
+    "NodeID",
+    "NodeInfo",
+    "PeerError",
+    "node_id_from_pubkey",
+]

@@ -1,0 +1,190 @@
+"""Peer lifecycle manager (reference internal/p2p/peermanager.go:273).
+
+Tracks the address book and per-peer state: connection status, mutable
+score, dial failures with exponential backoff. The Router asks it which
+address to dial next and reports accept/dial/disconnect/error events;
+reactors learn about peer up/down through `subscribe()` (the reference's
+PeerUpdates)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+
+from .types import NodeAddress, NodeID, PeerError
+
+
+class PeerStatus(str, Enum):
+    UP = "up"
+    DOWN = "down"
+
+
+@dataclass(frozen=True)
+class PeerUpdate:
+    node_id: NodeID
+    status: PeerStatus
+
+
+@dataclass
+class _PeerInfo:
+    node_id: NodeID
+    addresses: dict[str, NodeAddress] = field(default_factory=dict)
+    persistent: bool = False
+    score: int = 0
+    dial_failures: int = 0
+    last_dial_failure: float = 0.0
+    connected: bool = False
+    inbound: bool = False
+
+
+class PeerManager:
+    def __init__(
+        self,
+        self_id: NodeID,
+        *,
+        max_connected: int = 16,
+        max_connected_upper: int = 24,  # accept surplus before evicting
+        min_retry_time: float = 0.25,
+        max_retry_time: float = 30.0,
+        logger: logging.Logger | None = None,
+    ):
+        self.self_id = self_id
+        self.max_connected = max_connected
+        self.max_connected_upper = max_connected_upper
+        self.min_retry_time = min_retry_time
+        self.max_retry_time = max_retry_time
+        self.logger = logger or logging.getLogger("peermanager")
+        self._peers: dict[NodeID, _PeerInfo] = {}
+        self._subscribers: list[asyncio.Queue] = []
+        self._dial_wake = asyncio.Event()
+
+    # -- address book ----------------------------------------------------
+
+    def add_address(self, address: NodeAddress, *, persistent: bool = False) -> bool:
+        if address.node_id == self.self_id:
+            return False
+        info = self._peers.setdefault(address.node_id, _PeerInfo(address.node_id))
+        info.addresses[str(address)] = address
+        info.persistent = info.persistent or persistent
+        self._dial_wake.set()
+        return True
+
+    def addresses(self, node_id: NodeID) -> list[NodeAddress]:
+        info = self._peers.get(node_id)
+        return list(info.addresses.values()) if info else []
+
+    def all_known(self) -> list[NodeAddress]:
+        out = []
+        for info in self._peers.values():
+            out.extend(info.addresses.values())
+        return out
+
+    def connected_peers(self) -> list[NodeID]:
+        return [nid for nid, p in self._peers.items() if p.connected]
+
+    def num_connected(self) -> int:
+        return sum(1 for p in self._peers.values() if p.connected)
+
+    # -- dialing ---------------------------------------------------------
+
+    def _retry_delay(self, info: _PeerInfo) -> float:
+        if info.dial_failures == 0:
+            return 0.0
+        return min(
+            self.min_retry_time * (2 ** (info.dial_failures - 1)),
+            self.max_retry_time,
+        )
+
+    def try_dial_next(self) -> NodeAddress | None:
+        """Best eligible address to dial, or None (reference
+        TryDialNext)."""
+        if self.num_connected() >= self.max_connected:
+            return None
+        now = time.monotonic()
+        candidates = [
+            p
+            for p in self._peers.values()
+            if not p.connected
+            and p.addresses
+            and now - p.last_dial_failure >= self._retry_delay(p)
+        ]
+        if not candidates:
+            return None
+        # prefer persistent, then higher score, then fewer failures
+        best = max(
+            candidates,
+            key=lambda p: (p.persistent, p.score, -p.dial_failures),
+        )
+        return next(iter(best.addresses.values()))
+
+    async def wait_for_dialable(self, timeout: float = 0.5) -> None:
+        """Block until an address is (likely) dialable or timeout."""
+        try:
+            await asyncio.wait_for(self._dial_wake.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        self._dial_wake.clear()
+
+    def dial_failed(self, address: NodeAddress) -> None:
+        info = self._peers.get(address.node_id)
+        if info is not None:
+            info.dial_failures += 1
+            info.last_dial_failure = time.monotonic()
+
+    # -- connection events ----------------------------------------------
+
+    def connected(self, node_id: NodeID, *, inbound: bool) -> bool:
+        """Register a connection; False to refuse (already connected /
+        over the upper limit / self)."""
+        if node_id == self.self_id:
+            return False
+        if self.num_connected() >= self.max_connected_upper:
+            return False
+        info = self._peers.setdefault(node_id, _PeerInfo(node_id))
+        if info.connected:
+            return False
+        info.connected = True
+        info.inbound = inbound
+        info.dial_failures = 0
+        info.score += 1
+        self._notify(PeerUpdate(node_id, PeerStatus.UP))
+        return True
+
+    def disconnected(self, node_id: NodeID) -> None:
+        info = self._peers.get(node_id)
+        if info is not None and info.connected:
+            info.connected = False
+            self._notify(PeerUpdate(node_id, PeerStatus.DOWN))
+            self._dial_wake.set()
+
+    def errored(self, err: PeerError) -> None:
+        info = self._peers.get(err.node_id)
+        if info is not None:
+            info.score -= 5
+            self.logger.info("peer %s errored: %s (score %d)", err.node_id[:12], err.err, info.score)
+
+    def evict_candidate(self) -> NodeID | None:
+        """Lowest-score connected peer when over capacity."""
+        if self.num_connected() <= self.max_connected:
+            return None
+        connected = [p for p in self._peers.values() if p.connected and not p.persistent]
+        if not connected:
+            return None
+        return min(connected, key=lambda p: p.score).node_id
+
+    # -- subscriptions ---------------------------------------------------
+
+    def subscribe(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue(maxsize=256)
+        self._subscribers.append(q)
+        return q
+
+    def _notify(self, update: PeerUpdate) -> None:
+        for q in self._subscribers:
+            try:
+                q.put_nowait(update)
+            except asyncio.QueueFull:
+                self.logger.warning("peer-update subscriber overflowed")

@@ -1,0 +1,148 @@
+"""TCP transport: secret connection + channel-tagged message framing
+(reference internal/p2p/transport_mconn.go wrapping conn/connection.go).
+
+Message framing on top of the SecretStream byte stream:
+  [1-byte type][1-byte channel][4-byte BE length][payload]
+types: 0x01 data, 0x02 ping, 0x03 pong. Queue disciplines (priorities,
+backpressure) live in the Router's per-peer queues — the wire itself is
+FIFO, mirroring the reference's new-stack split where MConnection's
+legacy per-channel scheduling moved up into the Router queues."""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from .secret import SecretStream
+from .transport import Connection, ConnectionClosedError, Transport
+from .types import NodeAddress, NodeInfo, node_id_from_pubkey
+
+_T_DATA = 0x01
+_T_PING = 0x02
+_T_PONG = 0x03
+
+MAX_MSG_SIZE = 32 * 1024 * 1024
+PING_INTERVAL = 30.0
+
+
+class TCPConnection(Connection):
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._stream = SecretStream(reader, writer)
+        self._writer = writer
+        self._send_lock = asyncio.Lock()
+        self._closed = False
+        self._ping_task: asyncio.Task | None = None
+
+    async def handshake(self, node_info: NodeInfo, priv_key) -> NodeInfo:
+        peer_key = await self._stream.handshake(priv_key)
+        enc = node_info.encode()
+        await self._send_raw(_T_DATA, 0xFF, enc)
+        t, _ch, payload = await self._recv_raw()
+        if t != _T_DATA:
+            raise ConnectionError("expected NodeInfo during handshake")
+        peer_info = NodeInfo.decode(payload)
+        # the peer's claimed node id must match its authenticated key
+        if peer_info.node_id != node_id_from_pubkey(peer_key):
+            raise ConnectionError("peer node id does not match its pubkey")
+        self._ping_task = asyncio.get_running_loop().create_task(self._ping_loop())
+        return peer_info
+
+    async def _ping_loop(self) -> None:
+        while not self._closed:
+            await asyncio.sleep(PING_INTERVAL)
+            try:
+                await self._send_raw(_T_PING, 0, b"")
+            except Exception:
+                return
+
+    async def _send_raw(self, type_: int, channel_id: int, data: bytes) -> None:
+        if len(data) > MAX_MSG_SIZE:
+            raise ValueError("message too large")
+        async with self._send_lock:
+            hdr = struct.pack(">BBI", type_, channel_id, len(data))
+            await self._stream.write_all(hdr + data)
+
+    async def _recv_raw(self) -> tuple[int, int, bytes]:
+        hdr = await self._stream.read_exactly(6)
+        type_, ch, n = struct.unpack(">BBI", hdr)
+        if n > MAX_MSG_SIZE:
+            raise ConnectionError("oversized message")
+        payload = await self._stream.read_exactly(n) if n else b""
+        return type_, ch, payload
+
+    async def send_message(self, channel_id: int, data: bytes) -> None:
+        if self._closed:
+            raise ConnectionClosedError("connection closed")
+        try:
+            await self._send_raw(_T_DATA, channel_id, data)
+        except (ConnectionError, asyncio.IncompleteReadError, OSError) as e:
+            raise ConnectionClosedError(str(e)) from e
+
+    async def receive_message(self) -> tuple[int, bytes]:
+        while True:
+            if self._closed:
+                raise ConnectionClosedError("connection closed")
+            try:
+                t, ch, payload = await self._recv_raw()
+            except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
+                raise ConnectionClosedError(str(e)) from e
+            if t == _T_DATA:
+                return ch, payload
+            if t == _T_PING:
+                try:
+                    await self._send_raw(_T_PONG, 0, b"")
+                except Exception:
+                    pass
+            # pongs are simply fresh-ness signals; drop
+
+    @property
+    def remote_addr(self) -> str:
+        peername = self._writer.get_extra_info("peername")
+        return f"{peername[0]}:{peername[1]}" if peername else ""
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._ping_task is not None:
+            self._ping_task.cancel()
+        self._stream.close()
+
+
+class TCPTransport(Transport):
+    PROTOCOL = "tcp"
+
+    def __init__(self):
+        self._server: asyncio.AbstractServer | None = None
+        self._accept_q: asyncio.Queue[TCPConnection | None] = asyncio.Queue(64)
+        self._endpoint: str | None = None
+
+    async def listen(self, endpoint: str) -> None:
+        host, _, port = endpoint.rpartition(":")
+        self._server = await asyncio.start_server(
+            self._on_client, host or "0.0.0.0", int(port)
+        )
+        sock = self._server.sockets[0]
+        addr = sock.getsockname()
+        self._endpoint = f"{addr[0]}:{addr[1]}"
+
+    async def _on_client(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        await self._accept_q.put(TCPConnection(reader, writer))
+
+    def endpoint(self) -> str | None:
+        return self._endpoint
+
+    async def accept(self) -> Connection:
+        conn = await self._accept_q.get()
+        if conn is None:
+            raise ConnectionClosedError("transport closed")
+        return conn
+
+    async def dial(self, address: NodeAddress) -> Connection:
+        reader, writer = await asyncio.open_connection(address.host, address.port)
+        return TCPConnection(reader, writer)
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        self._accept_q.put_nowait(None)

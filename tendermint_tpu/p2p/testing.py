@@ -1,0 +1,75 @@
+"""In-process p2p test network (reference internal/p2p/p2ptest/network.go
+MakeNetwork) — N routers over the shared in-memory transport, fully
+meshed. The load-bearing fixture that lets every distributed protocol be
+unit-tested without sockets (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..crypto import ed25519
+from .memory import MemoryNetwork
+from .peermanager import PeerManager, PeerStatus
+from .router import Router
+from .types import NodeAddress, NodeInfo, node_id_from_pubkey
+
+
+class TestNode:
+    __test__ = False  # not a pytest class
+
+    def __init__(self, network: "TestNetwork", index: int, chain_id: str):
+        self.priv_key = ed25519.Ed25519PrivKey(
+            bytes([index + 1]) * 31 + bytes([0x7F])
+        )
+        self.node_id = node_id_from_pubkey(self.priv_key.pub_key())
+        self.node_info = NodeInfo(
+            node_id=self.node_id, network=chain_id, moniker=f"node{index}"
+        )
+        self.transport = network.memory.create_transport(self.node_id)
+        self.peer_manager = PeerManager(self.node_id, max_connected=64)
+        self.router = Router(
+            self.node_info, self.priv_key, self.peer_manager, [self.transport]
+        )
+
+    def address(self) -> NodeAddress:
+        return NodeAddress(node_id=self.node_id, protocol="memory")
+
+
+class TestNetwork:
+    __test__ = False
+
+    def __init__(self, n: int, chain_id: str = "test-chain"):
+        self.memory = MemoryNetwork()
+        self.nodes = [TestNode(self, i, chain_id) for i in range(n)]
+
+    def open_channel(self, channel_id: int, **kwargs) -> dict[str, object]:
+        """Open the same channel on every node; returns node_id → Channel."""
+        return {
+            node.node_id: node.router.open_channel(channel_id, **kwargs)
+            for node in self.nodes
+        }
+
+    async def start(self, *, mesh: bool = True) -> None:
+        for node in self.nodes:
+            await node.router.start()
+        if mesh:
+            for i, a in enumerate(self.nodes):
+                for b in self.nodes[i + 1 :]:
+                    a.peer_manager.add_address(b.address())
+            await self.wait_for_mesh()
+
+    async def wait_for_mesh(self, timeout: float = 10.0) -> None:
+        """Wait until every node sees every other node UP."""
+
+        async def _one(node: TestNode):
+            want = {n.node_id for n in self.nodes} - {node.node_id}
+            while set(node.peer_manager.connected_peers()) != want:
+                await asyncio.sleep(0.01)
+
+        await asyncio.wait_for(
+            asyncio.gather(*(_one(n) for n in self.nodes)), timeout
+        )
+
+    async def stop(self) -> None:
+        for node in self.nodes:
+            await node.router.stop()

@@ -1,0 +1,245 @@
+"""P2P stack tests (modeled on reference internal/p2p/router_test.go,
+conn/secret_connection_test.go, peermanager_test.go)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.p2p.memory import MemoryNetwork
+from tendermint_tpu.p2p.peermanager import PeerManager, PeerStatus
+from tendermint_tpu.p2p.secret import SecretStream
+from tendermint_tpu.p2p.tcp import TCPTransport
+from tendermint_tpu.p2p.testing import TestNetwork
+from tendermint_tpu.p2p.types import (
+    Envelope,
+    NodeAddress,
+    NodeInfo,
+    PeerError,
+    node_id_from_pubkey,
+)
+
+
+class TestNodeAddress:
+    def test_parse_roundtrip(self):
+        a = NodeAddress.parse("tcp://abcd1234@127.0.0.1:26656")
+        assert a.node_id == "abcd1234"
+        assert a.host == "127.0.0.1" and a.port == 26656
+        assert NodeAddress.parse(str(a)) == a
+        m = NodeAddress.parse("memory:ff00")
+        assert m.protocol == "memory" and m.node_id == "ff00"
+
+    def test_node_info_roundtrip(self):
+        ni = NodeInfo(
+            node_id="ab" * 20, network="chain-x", listen_addr="tcp://1.2.3.4:1",
+            channels=bytes([0x20, 0x30]), moniker="m",
+        )
+        assert NodeInfo.decode(ni.encode()) == ni
+        other = NodeInfo(node_id="cd" * 20, network="chain-y")
+        assert ni.compatible_with(other) is not None
+
+
+class TestSecretConnection:
+    @pytest.mark.asyncio
+    async def test_handshake_and_transfer(self):
+        """Full STS handshake over a real socketpair; large messages span
+        many sealed frames."""
+        server_priv = ed25519.Ed25519PrivKey.generate()
+        client_priv = ed25519.Ed25519PrivKey.generate()
+        results = {}
+
+        async def on_client(reader, writer):
+            s = SecretStream(reader, writer)
+            peer = await s.handshake(server_priv)
+            results["server_saw"] = peer.bytes()
+            data = await s.read_exactly(5000)
+            await s.write_all(data[::-1])
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        s = SecretStream(reader, writer)
+        peer = await s.handshake(client_priv)
+        assert peer.bytes() == server_priv.pub_key().bytes()
+        payload = bytes(range(256)) * 20  # 5120... use exactly 5000
+        payload = payload[:5000]
+        await s.write_all(payload)
+        echoed = await s.read_exactly(5000)
+        assert echoed == payload[::-1]
+        assert results["server_saw"] == client_priv.pub_key().bytes()
+        s.close()
+        server.close()
+
+    @pytest.mark.asyncio
+    async def test_tampered_frame_rejected(self):
+        server_priv = ed25519.Ed25519PrivKey.generate()
+        client_priv = ed25519.Ed25519PrivKey.generate()
+
+        async def on_client(reader, writer):
+            s = SecretStream(reader, writer)
+            await s.handshake(server_priv)
+            # send a frame, then corrupt the next one at the raw socket
+            await s.write_all(b"ok")
+            writer.write(b"\x00" * 1042)  # garbage sealed frame
+            await writer.drain()
+
+        server = await asyncio.start_server(on_client, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        s = SecretStream(reader, writer)
+        await s.handshake(client_priv)
+        assert await s.read_exactly(2) == b"ok"
+        with pytest.raises(Exception):
+            await s.read_exactly(1)
+        s.close()
+        server.close()
+
+
+class TestTCPTransport:
+    @pytest.mark.asyncio
+    async def test_dial_handshake_exchange(self):
+        priv_a, priv_b = (ed25519.Ed25519PrivKey.generate() for _ in range(2))
+        id_a = node_id_from_pubkey(priv_a.pub_key())
+        id_b = node_id_from_pubkey(priv_b.pub_key())
+        info_a = NodeInfo(node_id=id_a, network="c")
+        info_b = NodeInfo(node_id=id_b, network="c")
+
+        ta, tb = TCPTransport(), TCPTransport()
+        await tb.listen("127.0.0.1:0")
+        host, port = tb.endpoint().rsplit(":", 1)
+
+        async def server():
+            conn = await tb.accept()
+            peer = await conn.handshake(info_b, priv_b)
+            assert peer.node_id == id_a
+            ch, data = await conn.receive_message()
+            await conn.send_message(ch, data.upper())
+            return conn
+
+        stask = asyncio.create_task(server())
+        conn = await ta.dial(
+            NodeAddress(node_id=id_b, host=host, port=int(port))
+        )
+        peer = await conn.handshake(info_a, priv_a)
+        assert peer.node_id == id_b
+        await conn.send_message(0x42, b"hello")
+        ch, data = await conn.receive_message()
+        assert (ch, data) == (0x42, b"HELLO")
+        sconn = await stask
+        await conn.close()
+        await sconn.close()
+        await ta.close()
+        await tb.close()
+
+
+class TestPeerManager:
+    def test_dial_retry_backoff(self):
+        pm = PeerManager("self", min_retry_time=10.0)
+        addr = NodeAddress(node_id="peer1", protocol="memory")
+        pm.add_address(addr)
+        assert pm.try_dial_next() == addr
+        pm.dial_failed(addr)
+        assert pm.try_dial_next() is None  # backoff
+        assert pm.addresses("peer1") == [addr]
+
+    def test_connected_limits_and_updates(self):
+        pm = PeerManager("self", max_connected=1, max_connected_upper=2)
+        sub = pm.subscribe()
+        assert pm.connected("p1", inbound=True)
+        assert not pm.connected("p1", inbound=True)  # duplicate
+        assert pm.connected("p2", inbound=True)  # surplus allowed
+        assert not pm.connected("p3", inbound=True)  # over upper
+        assert pm.evict_candidate() is not None
+        up = sub.get_nowait()
+        assert up.status == PeerStatus.UP
+        pm.disconnected("p1")
+        assert pm.connected_peers() == ["p2"]
+
+    def test_error_scoring(self):
+        pm = PeerManager("self")
+        pm.connected("p1", inbound=True)
+        pm.errored(PeerError("p1", "bad vote"))
+        assert pm._peers["p1"].score < 1
+
+
+class TestRouterNetwork:
+    @pytest.mark.asyncio
+    async def test_broadcast_and_point_to_point(self):
+        net = TestNetwork(3)
+        chans = net.open_channel(0x77, name="test")
+        await net.start()
+        try:
+            a, b, c = net.nodes
+            # broadcast from a reaches b and c
+            await chans[a.node_id].send(
+                Envelope(channel_id=0x77, message=b"hi-all", broadcast=True)
+            )
+            for node in (b, c):
+                env = await asyncio.wait_for(chans[node.node_id].receive(), 5)
+                assert env.message == b"hi-all"
+                assert env.from_ == a.node_id
+            # direct message b -> c only
+            await chans[b.node_id].send(
+                Envelope(channel_id=0x77, message=b"direct", to=c.node_id)
+            )
+            env = await asyncio.wait_for(chans[c.node_id].receive(), 5)
+            assert env.message == b"direct" and env.from_ == b.node_id
+            assert chans[a.node_id].in_q.empty()
+        finally:
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_peer_error_disconnects(self):
+        net = TestNetwork(2)
+        chans = net.open_channel(0x77, name="test")
+        await net.start()
+        try:
+            a, b = net.nodes
+            sub = a.peer_manager.subscribe()
+            await chans[a.node_id].error(PeerError(b.node_id, "misbehaved"))
+            upd = await asyncio.wait_for(sub.get(), 5)
+            assert upd.status == PeerStatus.DOWN
+            assert b.node_id not in a.peer_manager.connected_peers()
+        finally:
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_codec_and_malformed_message(self):
+        import json
+
+        net = TestNetwork(2)
+        chans = net.open_channel(
+            0x50,
+            name="json",
+            encode=lambda m: json.dumps(m).encode(),
+            decode=lambda b: json.loads(b.decode()),
+        )
+        await net.start()
+        try:
+            a, b = net.nodes
+            await chans[a.node_id].send(
+                Envelope(channel_id=0x50, message={"x": 1}, broadcast=True)
+            )
+            env = await asyncio.wait_for(chans[b.node_id].receive(), 5)
+            assert env.message == {"x": 1}
+        finally:
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_reconnect_after_disconnect(self):
+        """Dropped peers are redialed (peer manager retry loop)."""
+        net = TestNetwork(2)
+        net.open_channel(0x77, name="test")
+        await net.start()
+        try:
+            a, b = net.nodes
+            sub = a.peer_manager.subscribe()
+            # force-disconnect from a's side
+            await a.router._disconnect_peer(b.node_id)
+            # a should redial b (it has its address) and come back up
+            deadline = asyncio.get_running_loop().time() + 10
+            while b.node_id not in a.peer_manager.connected_peers():
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.05)
+        finally:
+            await net.stop()
