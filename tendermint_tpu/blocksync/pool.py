@@ -1,0 +1,171 @@
+"""Block pool — schedules concurrent block requests across peers and
+hands back blocks in height order (reference internal/blocksync/v0/pool.go:69:
+up to 600 in-flight requesters, ≤20 per peer).
+
+`next_requests()` yields (height, peer) assignments; the reactor sends
+BlockRequests and feeds responses back via `add_block`. `peek_range`
+returns the contiguous run of downloaded blocks starting at `height` —
+the unit the reactor feeds to the range-batched verifier."""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+from ..types.block import Block
+
+REQUEST_WINDOW = 128  # in-flight heights (reference: 600)
+PER_PEER_LIMIT = 16  # reference maxPendingRequestsPerPeer=20
+REQUEST_TIMEOUT = 15.0
+
+
+@dataclass
+class _Peer:
+    peer_id: str
+    base: int = 0
+    height: int = 0
+    pending: set[int] = field(default_factory=set)
+    timeouts: int = 0
+
+
+@dataclass
+class _Request:
+    height: int
+    peer_id: str
+    time: float
+
+
+class BlockPool:
+    def __init__(self, start_height: int, *, logger: logging.Logger | None = None):
+        self.height = start_height  # next height to hand to the verifier
+        self.logger = logger or logging.getLogger("blockpool")
+        self.peers: dict[str, _Peer] = {}
+        self.requests: dict[int, _Request] = {}  # height -> outstanding req
+        self.blocks: dict[int, tuple[Block, str]] = {}  # height -> (block, provider)
+        self.started_at = time.monotonic()
+        self._last_advance = time.monotonic()
+
+    # -- peers -----------------------------------------------------------
+
+    def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        p = self.peers.setdefault(peer_id, _Peer(peer_id))
+        p.base, p.height = base, height
+
+    def remove_peer(self, peer_id: str) -> list[int]:
+        """Returns heights that must be re-requested."""
+        p = self.peers.pop(peer_id, None)
+        if p is None:
+            return []
+        redo = []
+        for h in list(p.pending):
+            self.requests.pop(h, None)
+            if h not in self.blocks:
+                redo.append(h)
+        return redo
+
+    def max_peer_height(self) -> int:
+        return max((p.height for p in self.peers.values()), default=0)
+
+    # -- request scheduling ---------------------------------------------
+
+    def next_requests(self) -> list[tuple[int, str]]:
+        """Assign un-requested heights within the window to peers with
+        capacity (reference makeNextRequests pool.go:394)."""
+        out = []
+        now = time.monotonic()
+        # retry timed-out requests first
+        for h, req in list(self.requests.items()):
+            if now - req.time > REQUEST_TIMEOUT and h not in self.blocks:
+                p = self.peers.get(req.peer_id)
+                if p is not None:
+                    p.pending.discard(h)
+                    p.timeouts += 1
+                del self.requests[h]
+        for h in range(self.height, self.height + REQUEST_WINDOW):
+            if h in self.blocks or h in self.requests:
+                continue
+            peer = self._pick_peer(h)
+            if peer is None:
+                continue
+            peer.pending.add(h)
+            self.requests[h] = _Request(h, peer.peer_id, now)
+            out.append((h, peer.peer_id))
+        return out
+
+    def _pick_peer(self, height: int) -> _Peer | None:
+        best = None
+        for p in self.peers.values():
+            if not (p.base <= height <= p.height):
+                continue
+            if len(p.pending) >= PER_PEER_LIMIT:
+                continue
+            if best is None or len(p.pending) < len(best.pending):
+                best = p
+        return best
+
+    # -- block intake ----------------------------------------------------
+
+    def add_block(self, peer_id: str, block: Block) -> bool:
+        h = block.header.height
+        req = self.requests.get(h)
+        if h < self.height or h in self.blocks:
+            return False
+        # accept unsolicited blocks too (reference logs; we take them)
+        self.blocks[h] = (block, peer_id)
+        p = self.peers.get(peer_id)
+        if p is not None:
+            p.pending.discard(h)
+        if req is not None:
+            # free the slot of whichever peer currently holds the
+            # assignment (may differ from the sender after a timeout
+            # re-assignment)
+            assigned = self.peers.get(req.peer_id)
+            if assigned is not None:
+                assigned.pending.discard(h)
+            del self.requests[h]
+        return True
+
+    def no_block(self, peer_id: str, height: int) -> None:
+        req = self.requests.get(height)
+        if req is not None and req.peer_id == peer_id:
+            del self.requests[height]
+            p = self.peers.get(peer_id)
+            if p is not None:
+                p.pending.discard(height)
+
+    # -- consumption -----------------------------------------------------
+
+    def peek_range(self, max_len: int) -> list[tuple[Block, str]]:
+        """Contiguous downloaded blocks starting at self.height. Block-
+        sync verification of height h needs h+1's LastCommit, so the
+        last block of the run is returned only as the verifier for its
+        predecessor (the caller applies [0:-1])."""
+        out = []
+        h = self.height
+        while len(out) < max_len and h in self.blocks:
+            out.append(self.blocks[h])
+            h += 1
+        return out
+
+    def pop(self, height: int) -> None:
+        """Block applied; advance."""
+        self.blocks.pop(height, None)
+        if height >= self.height:
+            self.height = height + 1
+            self._last_advance = time.monotonic()
+
+    def redo(self, height: int, *bad_peers: str) -> None:
+        """Verification failed: drop blocks from the offending providers
+        and re-request (reference RedoRequest)."""
+        for h in list(self.blocks):
+            if h >= height and self.blocks[h][1] in bad_peers:
+                del self.blocks[h]
+        for pid in bad_peers:
+            self.remove_peer(pid)
+
+    def is_caught_up(self) -> bool:
+        """Within one block of the best peer (reference IsCaughtUp)."""
+        if not self.peers:
+            return False
+        return self.height >= self.max_peer_height()

@@ -1,0 +1,428 @@
+"""Consensus reactor (reference internal/consensus/reactor.go).
+
+Four wire channels (reactor.go:84-87):
+  0x20 state — NewRoundStep / NewValidBlock / HasVote / VoteSetMaj23
+  0x21 data  — Proposal / ProposalPOL / BlockPart
+  0x22 vote  — Vote
+  0x23 vote-set-bits — VoteSetBits
+
+Per-peer gossip tasks mirror the reference's three goroutines
+(gossipDataRoutine :519, gossipVotesRoutine :731, queryMaj23Routine
+:813): each loops over the local RoundState vs the tracked PeerState and
+sends exactly what the peer is missing."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from ..libs.service import Service
+from ..p2p.peermanager import PeerStatus
+from ..p2p.router import Channel
+from ..p2p.types import Envelope, PeerError
+from ..types.block import Commit
+from ..types.keys import SignedMsgType
+from ..types.vote import Vote
+
+from . import messages as m
+from .peer_state import PeerState
+from .state import ConsensusState
+from .types import RoundStep
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+GOSSIP_SLEEP = 0.05  # reference peerGossipSleepDuration=100ms; we poll faster
+QUERY_MAJ23_SLEEP = 2.0
+
+
+class ConsensusReactor(Service):
+    def __init__(
+        self,
+        cs: ConsensusState,
+        state_ch: Channel,
+        data_ch: Channel,
+        vote_ch: Channel,
+        bits_ch: Channel,
+        peer_updates: asyncio.Queue,
+        *,
+        logger: logging.Logger | None = None,
+    ):
+        super().__init__("cs-reactor", logger)
+        self.cs = cs
+        self.state_ch = state_ch
+        self.data_ch = data_ch
+        self.vote_ch = vote_ch
+        self.bits_ch = bits_ch
+        self.peer_updates = peer_updates
+        self.peers: dict[str, PeerState] = {}
+        self._peer_tasks: dict[str, list[asyncio.Task]] = {}
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def on_start(self) -> None:
+        self.cs.step_hook = self._on_new_step
+        self.cs.broadcast_hook = self._on_broadcast
+        self.spawn(self._process_peer_updates(), name="csr.peers")
+        self.spawn(self._process_state_ch(), name="csr.state")
+        self.spawn(self._process_data_ch(), name="csr.data")
+        self.spawn(self._process_vote_ch(), name="csr.vote")
+        self.spawn(self._process_bits_ch(), name="csr.bits")
+
+    async def on_stop(self) -> None:
+        self.cs.step_hook = None
+        self.cs.broadcast_hook = None
+        for tasks in self._peer_tasks.values():
+            for t in tasks:
+                t.cancel()
+
+    # -- hooks from the state machine -----------------------------------
+
+    def _new_round_step_msg(self) -> m.NewRoundStepMessage:
+        rs = self.cs.rs
+        return m.NewRoundStepMessage(
+            height=rs.height,
+            round=rs.round,
+            step=int(rs.step),
+            seconds_since_start_time=max(
+                0, int((time.time_ns() - rs.start_time_ns) / 1e9)
+            ),
+            last_commit_round=rs.last_commit.round if rs.last_commit else -1,
+        )
+
+    def _on_new_step(self, rs) -> None:
+        self._send_nowait(
+            self.state_ch, Envelope(STATE_CHANNEL, self._new_round_step_msg(), broadcast=True)
+        )
+
+    def _on_broadcast(self, msg) -> None:
+        """Out-of-band broadcasts from the SM: HasVote/NewValidBlock go to
+        the state channel; proposal/parts/votes are handled by gossip
+        (but broadcasting them too cuts a round-trip on small nets)."""
+        if isinstance(msg, (m.HasVoteMessage, m.NewValidBlockMessage)):
+            self._send_nowait(self.state_ch, Envelope(STATE_CHANNEL, msg, broadcast=True))
+
+    def _send_nowait(self, ch: Channel, env: Envelope) -> None:
+        try:
+            ch.out_q.put_nowait(env)
+        except asyncio.QueueFull:
+            self.logger.warning("dropping outbound on %s: full", ch.name)
+
+    # -- peer lifecycle --------------------------------------------------
+
+    async def _process_peer_updates(self) -> None:
+        while True:
+            upd = await self.peer_updates.get()
+            if upd.status == PeerStatus.UP:
+                if upd.node_id in self.peers:
+                    continue
+                ps = PeerState(upd.node_id)
+                self.peers[upd.node_id] = ps
+                self._peer_tasks[upd.node_id] = [
+                    self.spawn(self._gossip_data(ps), name=f"csr.gd.{upd.node_id[:8]}"),
+                    self.spawn(self._gossip_votes(ps), name=f"csr.gv.{upd.node_id[:8]}"),
+                    self.spawn(self._query_maj23(ps), name=f"csr.qm.{upd.node_id[:8]}"),
+                ]
+                # tell the new peer where we are
+                self._send_nowait(
+                    self.state_ch,
+                    Envelope(STATE_CHANNEL, self._new_round_step_msg(), to=upd.node_id),
+                )
+            else:
+                self.peers.pop(upd.node_id, None)
+                for t in self._peer_tasks.pop(upd.node_id, []):
+                    t.cancel()
+
+    # -- inbound processing ---------------------------------------------
+
+    async def _process_state_ch(self) -> None:
+        async for env in self.state_ch:
+            ps = self.peers.get(env.from_)
+            if ps is None:
+                continue
+            msg = env.message
+            try:
+                if isinstance(msg, m.NewRoundStepMessage):
+                    ps.apply_new_round_step(msg)
+                elif isinstance(msg, m.NewValidBlockMessage):
+                    ps.apply_new_valid_block(msg)
+                elif isinstance(msg, m.HasVoteMessage):
+                    ps.apply_has_vote(msg)
+                elif isinstance(msg, m.VoteSetMaj23Message):
+                    await self._handle_vote_set_maj23(env.from_, msg)
+            except Exception as e:
+                await self.state_ch.error(PeerError(env.from_, f"state msg: {e!r}"))
+
+    async def _handle_vote_set_maj23(self, peer_id: str, msg) -> None:
+        """Record the claim, reply with our bits for that (round, type,
+        block) (reference handleStateMessage VoteSetMaj23)."""
+        rs = self.cs.rs
+        if rs.height != msg.height or rs.votes is None:
+            return
+        rs.votes.set_peer_maj23(msg.round, msg.type, peer_id)
+        vs = (
+            rs.votes.prevotes(msg.round)
+            if msg.type == SignedMsgType.PREVOTE
+            else rs.votes.precommits(msg.round)
+        )
+        if vs is None:
+            return
+        bits = vs.bit_array_by_block_id(msg.block_id)
+        if bits is None:
+            from ..libs.bits import BitArray
+
+            bits = BitArray(vs.size())
+        self._send_nowait(
+            self.bits_ch,
+            Envelope(
+                VOTE_SET_BITS_CHANNEL,
+                m.VoteSetBitsMessage(msg.height, msg.round, msg.type, msg.block_id, bits),
+                to=peer_id,
+            ),
+        )
+
+    async def _process_data_ch(self) -> None:
+        async for env in self.data_ch:
+            ps = self.peers.get(env.from_)
+            msg = env.message
+            try:
+                if isinstance(msg, m.ProposalMessage):
+                    if ps is not None:
+                        ps.set_has_proposal(msg.proposal)
+                    await self.cs.add_proposal(msg.proposal, env.from_)
+                elif isinstance(msg, m.ProposalPOLMessage):
+                    if ps is not None:
+                        ps.apply_proposal_pol(msg)
+                elif isinstance(msg, m.BlockPartMessage):
+                    if ps is not None:
+                        ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
+                    await self.cs.add_block_part(msg.height, msg.round, msg.part, env.from_)
+            except Exception as e:
+                await self.data_ch.error(PeerError(env.from_, f"data msg: {e!r}"))
+
+    async def _process_vote_ch(self) -> None:
+        async for env in self.vote_ch:
+            msg = env.message
+            if not isinstance(msg, m.VoteMessage):
+                continue
+            ps = self.peers.get(env.from_)
+            if ps is not None:
+                v = msg.vote
+                ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
+            await self.cs.add_vote(msg.vote, env.from_)
+
+    async def _process_bits_ch(self) -> None:
+        async for env in self.bits_ch:
+            msg = env.message
+            if not isinstance(msg, m.VoteSetBitsMessage):
+                continue
+            ps = self.peers.get(env.from_)
+            if ps is None:
+                continue
+            # mark all bits the peer claims to have
+            for idx in msg.votes.true_indices():
+                ps.set_has_vote(msg.height, msg.round, msg.type, idx)
+
+    # -- gossip routines -------------------------------------------------
+
+    async def _gossip_data(self, ps: PeerState) -> None:
+        """Reference gossipDataRoutine reactor.go:519."""
+        while True:
+            rs = self.cs.rs
+            prs = ps.prs
+            sent = False
+            if rs.height == prs.height and rs.proposal_block_parts is not None:
+                sent = self._send_missing_part(ps)
+            if not sent and rs.height == prs.height and rs.proposal is not None and not prs.proposal:
+                ps.set_has_proposal(rs.proposal)
+                self._send_nowait(
+                    self.data_ch,
+                    Envelope(DATA_CHANNEL, m.ProposalMessage(rs.proposal), to=ps.peer_id),
+                )
+                if rs.proposal.pol_round >= 0:
+                    pol = rs.votes.prevotes(rs.proposal.pol_round)
+                    if pol is not None:
+                        self._send_nowait(
+                            self.data_ch,
+                            Envelope(
+                                DATA_CHANNEL,
+                                m.ProposalPOLMessage(
+                                    rs.height,
+                                    rs.proposal.pol_round,
+                                    pol.votes_bit_array.copy(),
+                                ),
+                                to=ps.peer_id,
+                            ),
+                        )
+                sent = True
+            if not sent and 0 < prs.height < rs.height:
+                sent = self._send_catchup_part(ps)
+            if not sent:
+                await asyncio.sleep(GOSSIP_SLEEP)
+            else:
+                await asyncio.sleep(0)
+
+    def _send_missing_part(self, ps: PeerState) -> bool:
+        rs = self.cs.rs
+        prs = ps.prs
+        if prs.proposal_block_parts is None:
+            return False
+        ours = rs.proposal_block_parts.parts_bit_array
+        theirs = prs.proposal_block_parts
+        missing = ours.sub(theirs)
+        idx = missing.pick_random()
+        if idx is None:
+            return False
+        part = rs.proposal_block_parts.get_part(idx)
+        if part is None:
+            return False
+        ps.set_has_proposal_block_part(prs.height, prs.round, idx)
+        self._send_nowait(
+            self.data_ch,
+            Envelope(DATA_CHANNEL, m.BlockPartMessage(prs.height, prs.round, part), to=ps.peer_id),
+        )
+        return True
+
+    def _send_catchup_part(self, ps: PeerState) -> bool:
+        """Peer is on an earlier height: serve stored block parts
+        (reference gossipDataForCatchup reactor.go:577)."""
+        prs = ps.prs
+        meta = self.cs.block_store.load_block_meta(prs.height)
+        if meta is None:
+            return False
+        psh = meta.block_id.part_set_header
+        if prs.proposal_block_parts is None or prs.proposal_block_parts_header != (
+            psh.total,
+            psh.hash,
+        ):
+            from ..libs.bits import BitArray
+
+            prs.proposal_block_parts_header = (psh.total, psh.hash)
+            prs.proposal_block_parts = BitArray(psh.total)
+        # batched: send every part the peer is missing in one sweep (a
+        # catching-up peer must outpace live block production)
+        sent = False
+        for idx in prs.proposal_block_parts.not_().true_indices():
+            part = self.cs.block_store.load_block_part(prs.height, idx)
+            if part is None:
+                continue
+            prs.proposal_block_parts.set(idx, True)
+            self._send_nowait(
+                self.data_ch,
+                Envelope(
+                    DATA_CHANNEL,
+                    m.BlockPartMessage(prs.height, prs.round, part),
+                    to=ps.peer_id,
+                ),
+            )
+            sent = True
+        return sent
+
+    async def _gossip_votes(self, ps: PeerState) -> None:
+        """Reference gossipVotesRoutine reactor.go:731."""
+        while True:
+            rs = self.cs.rs
+            prs = ps.prs
+            sent = False
+            if rs.height == prs.height:
+                sent = self._gossip_votes_same_height(ps)
+            elif prs.height != 0 and rs.height == prs.height + 1 and rs.last_commit is not None:
+                sent = self._pick_send_vote(ps, rs.last_commit)
+            elif (
+                prs.height != 0
+                and rs.height >= prs.height + 2
+                and self.cs.block_store.base() <= prs.height <= self.cs.block_store.height()
+            ):
+                commit = self.cs.block_store.load_block_commit(prs.height)
+                if commit is not None:
+                    sent = self._send_catchup_commit_vote(ps, commit)
+            if not sent:
+                await asyncio.sleep(GOSSIP_SLEEP)
+            else:
+                await asyncio.sleep(0)
+
+    def _gossip_votes_same_height(self, ps: PeerState) -> bool:
+        rs = self.cs.rs
+        prs = ps.prs
+        # last commit first (peer may still be finishing the previous height)
+        if prs.step == int(RoundStep.NEW_HEIGHT) and rs.last_commit is not None:
+            if self._pick_send_vote(ps, rs.last_commit):
+                return True
+        # POL prevotes
+        if prs.proposal_pol_round != -1 and prs.proposal_pol_round <= rs.round:
+            pol = rs.votes.prevotes(prs.proposal_pol_round)
+            if pol is not None and self._pick_send_vote(ps, pol):
+                return True
+        if prs.round != -1 and prs.round <= rs.round:
+            if self._pick_send_vote(ps, rs.votes.prevotes(prs.round)):
+                return True
+            if self._pick_send_vote(ps, rs.votes.precommits(prs.round)):
+                return True
+        return False
+
+    def _pick_send_vote(self, ps: PeerState, votes) -> bool:
+        vote = ps.pick_vote_to_send(votes)
+        if vote is None:
+            return False
+        ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+        self._send_nowait(
+            self.vote_ch, Envelope(VOTE_CHANNEL, m.VoteMessage(vote), to=ps.peer_id)
+        )
+        return True
+
+    def _send_catchup_commit_vote(self, ps: PeerState, commit: Commit) -> bool:
+        """Send ALL missing precommits of a stored commit at once — a peer
+        catching up must close the gap faster than blocks are produced,
+        so catch-up gossip is batched rather than one-vote-per-tick."""
+        prs = ps.prs
+        ps.ensure_catchup_commit(prs.height, commit.round, len(commit.signatures))
+        have = prs.catchup_commit
+        sent = False
+        for idx, cs_ in enumerate(commit.signatures):
+            if cs_.is_absent() or have.get(idx):
+                continue
+            vote = Vote(
+                type=SignedMsgType.PRECOMMIT,
+                height=commit.height,
+                round=commit.round,
+                block_id=cs_.block_id(commit.block_id),
+                timestamp_ns=cs_.timestamp_ns,
+                validator_address=cs_.validator_address,
+                validator_index=idx,
+                signature=cs_.signature,
+            )
+            have.set(idx, True)
+            self._send_nowait(
+                self.vote_ch, Envelope(VOTE_CHANNEL, m.VoteMessage(vote), to=ps.peer_id)
+            )
+            sent = True
+        return sent
+
+    async def _query_maj23(self, ps: PeerState) -> None:
+        """Reference queryMaj23Routine reactor.go:813: periodically tell
+        peers which majorities we see so they can send us missing votes."""
+        while True:
+            await asyncio.sleep(QUERY_MAJ23_SLEEP)
+            rs = self.cs.rs
+            prs = ps.prs
+            if rs.votes is None or rs.height != prs.height:
+                continue
+            for type_, vs in (
+                (SignedMsgType.PREVOTE, rs.votes.prevotes(prs.round)),
+                (SignedMsgType.PRECOMMIT, rs.votes.precommits(prs.round)),
+            ):
+                if vs is None:
+                    continue
+                maj = vs.two_thirds_majority()
+                if maj is not None:
+                    self._send_nowait(
+                        self.state_ch,
+                        Envelope(
+                            STATE_CHANNEL,
+                            m.VoteSetMaj23Message(rs.height, prs.round, type_, maj),
+                            to=ps.peer_id,
+                        ),
+                    )

@@ -109,6 +109,7 @@ class ConsensusState(Service):
         self.step_hook: Callable[[RoundState], None] | None = None
 
         self._replay_mode = False
+        self._paused = False  # switch-back-to-blocksync gate
         self._n_started_height = 0
         self._wake = asyncio.Event()  # new-height nudge for tests
         self._decided: asyncio.Event = asyncio.Event()
@@ -224,12 +225,24 @@ class ConsensusState(Service):
         cs_height = self.rs.height
         recs = self.wal.search_for_end_height(cs_height - 1)
         if recs is None:
-            if cs_height == self.state.initial_height:
-                recs = []
-            else:
+            # Distinguish "WAL simply ends before that height" (fine: the
+            # node advanced via block-sync/state-sync, nothing to replay —
+            # the reference's io.EOF case) from "WAL reaches beyond it but
+            # the marker is missing" (corruption / double-sign hazard).
+            max_marker = -1
+            for rec in self.wal.iter_records():
+                if rec.kind == KIND_END_HEIGHT:
+                    max_marker = max(max_marker, rec.height)
+            if max_marker > cs_height - 1:
                 raise ConsensusError(
-                    f"WAL has no end-height record for {cs_height - 1}"
+                    f"WAL contains end-height {max_marker} beyond expected "
+                    f"{cs_height - 1}; refusing to start (double-sign hazard)"
                 )
+            if cs_height == self.state.initial_height or max_marker < cs_height - 1:
+                self.logger.info(
+                    "WAL ends before height %d; skipping replay", cs_height - 1
+                )
+                recs = []
         self._replay_mode = True
         try:
             for rec in recs:
@@ -248,9 +261,32 @@ class ConsensusState(Service):
     # the single-threaded event loop
     # ------------------------------------------------------------------
 
+    def pause(self) -> None:
+        """Freeze the state machine while block-sync re-takes over (the
+        node fell too far behind for vote gossip to catch up). Inputs are
+        dropped; timers are ignored."""
+        self._paused = True
+        self._finalize_pending = False
+        self.ticker.stop()
+
+    def resume_with_state(self, state: State) -> None:
+        """Resume after a re-sync at the new tip. Must be called from the
+        same event loop (the SM is single-task; this mutation is atomic
+        under cooperative scheduling)."""
+        self.rs.commit_round = -1
+        self.rs.last_commit = None
+        self.rs.commit_time_ns = 0
+        self.update_to_state(state)
+        self._paused = False
+        self._schedule_timeout(
+            self.config.timeout_commit_ns, self.rs.height, 0, RoundStep.NEW_HEIGHT
+        )
+
     async def _receive_routine(self) -> None:
         while True:
             item = await self.msg_queue.get()
+            if self._paused:
+                continue
             try:
                 if isinstance(item, TimeoutInfo):
                     self._wal_write(m.encode_wal_message(item), sync=True)
@@ -270,8 +306,14 @@ class ConsensusState(Service):
                 )
             except (VoteSetError, BlockValidationError, ValueError) as e:
                 self.logger.info("dropped invalid consensus input: %r", e)
-            # run any async follow-up (finalize) scheduled by handlers
-            await self._drain_finalize()
+            # run any async follow-up (finalize) scheduled by handlers;
+            # a failure here must not kill the receive loop
+            try:
+                await self._drain_finalize()
+            except Exception as e:
+                self.logger.error(
+                    "finalize failed at height %d: %r", self.rs.height, e
+                )
 
     def _wal_write(self, payload: bytes, *, sync: bool) -> None:
         if self.wal is None or self._replay_mode:

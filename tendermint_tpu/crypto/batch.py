@@ -8,10 +8,14 @@ batching (matching the reference) — callers fall back to single verification.
 
 from __future__ import annotations
 
+import logging
 import os
+import threading
 
 from . import BatchVerifier, PubKey
 from .ed25519 import KEY_TYPE as ED25519
+
+logger = logging.getLogger("crypto.batch")
 
 
 class CPUBatchVerifier(BatchVerifier):
@@ -29,23 +33,52 @@ class CPUBatchVerifier(BatchVerifier):
 
 
 _tpu_available: bool | None = None
+_tpu_probe_lock = threading.Lock()
+_tpu_probe_started = False
 
 
-def tpu_verifier_available() -> bool:
-    """True when a JAX accelerator (or forced CPU-jax) backend is usable for
-    batched verification. Cached; disable with TMTPU_DISABLE_TPU=1."""
+def _probe_tpu() -> None:
+    """Background probe: bring the JAX backend up and warm the kernel so
+    the first real batch doesn't pay backend-init + compile inline."""
     global _tpu_available
-    if _tpu_available is None:
-        if os.environ.get("TMTPU_DISABLE_TPU"):
-            _tpu_available = False
-        else:
-            try:
-                from .tpu.verify import backend_ready
+    try:
+        from .tpu.verify import backend_ready, warmup
 
-                _tpu_available = backend_ready()
-            except Exception:
-                _tpu_available = False
-    return _tpu_available
+        ok = backend_ready()
+        if ok:
+            warmup()
+        _tpu_available = ok
+        logger.info("TPU batch verifier %s", "ready" if ok else "unavailable")
+    except Exception as e:
+        logger.info("TPU batch verifier unavailable: %r", e)
+        _tpu_available = False
+
+
+def tpu_verifier_available(*, blocking: bool = False) -> bool:
+    """True when the JAX backend is up AND the kernel is warmed.
+
+    Backend init + first compile can take minutes (TPU tunnel, large
+    kernel), so the probe runs on a daemon thread and this returns False
+    — routing batches to the host verifier — until it finishes. Pass
+    blocking=True (benchmarks) to wait for the probe. Disable with
+    TMTPU_DISABLE_TPU=1."""
+    global _tpu_probe_started
+    if _tpu_available is not None:
+        return _tpu_available
+    if os.environ.get("TMTPU_DISABLE_TPU"):
+        return False
+    with _tpu_probe_lock:
+        if not _tpu_probe_started:
+            _tpu_probe_started = True
+            t = threading.Thread(target=_probe_tpu, name="tpu-probe", daemon=True)
+            t.start()
+    if blocking:
+        while _tpu_available is None:
+            import time
+
+            time.sleep(0.1)
+        return _tpu_available
+    return False if _tpu_available is None else _tpu_available
 
 
 # Below this many signatures the TPU round-trip (host transfer + launch

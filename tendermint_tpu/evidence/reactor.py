@@ -1,0 +1,87 @@
+"""Evidence gossip reactor (reference internal/evidence/reactor.go,
+channel 0x38): continuously offer all pending evidence to every peer;
+receivers verify and pool it."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..libs.service import Service
+from ..p2p.peermanager import PeerStatus
+from ..p2p.router import Channel
+from ..p2p.types import Envelope, PeerError
+from ..types.evidence import decode_evidence
+from . import EVIDENCE_CHANNEL
+from .pool import EvidenceError, EvidencePool
+
+BROADCAST_SLEEP = 0.25
+
+
+class EvidenceReactor(Service):
+    def __init__(
+        self,
+        pool: EvidencePool,
+        channel: Channel,
+        peer_updates: asyncio.Queue,
+        *,
+        logger: logging.Logger | None = None,
+    ):
+        super().__init__("ev-reactor", logger)
+        self.pool = pool
+        self.channel = channel
+        self.peer_updates = peer_updates
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+        self._sent: dict[str, set[bytes]] = {}
+
+    async def on_start(self) -> None:
+        self.spawn(self._process_peer_updates(), name="evr.peers")
+        self.spawn(self._process_inbound(), name="evr.in")
+
+    async def on_stop(self) -> None:
+        for t in self._peer_tasks.values():
+            t.cancel()
+
+    async def _process_peer_updates(self) -> None:
+        while True:
+            upd = await self.peer_updates.get()
+            if upd.status == PeerStatus.UP:
+                if upd.node_id not in self._peer_tasks:
+                    self._sent[upd.node_id] = set()
+                    self._peer_tasks[upd.node_id] = self.spawn(
+                        self._broadcast_to(upd.node_id),
+                        name=f"evr.bcast.{upd.node_id[:8]}",
+                    )
+            else:
+                t = self._peer_tasks.pop(upd.node_id, None)
+                if t is not None:
+                    t.cancel()
+                self._sent.pop(upd.node_id, None)
+
+    async def _process_inbound(self) -> None:
+        async for env in self.channel:
+            try:
+                ev = decode_evidence(env.message) if isinstance(env.message, bytes) else env.message
+                self.pool.add_evidence(ev)
+            except EvidenceError as e:
+                await self.channel.error(PeerError(env.from_, f"bad evidence: {e}"))
+            except Exception as e:
+                await self.channel.error(PeerError(env.from_, f"evidence: {e!r}"))
+
+    async def _broadcast_to(self, peer_id: str) -> None:
+        sent = self._sent[peer_id]
+        while True:
+            fresh = False
+            for ev in self.pool.pending_evidence(1 << 30)[0]:
+                h = ev.hash()
+                if h in sent:
+                    continue
+                # awaited put: backpressure instead of silently losing
+                # evidence gossip to this peer
+                await self.channel.out_q.put(
+                    Envelope(EVIDENCE_CHANNEL, ev, to=peer_id)
+                )
+                sent.add(h)
+                fresh = True
+            if not fresh:
+                await asyncio.sleep(BROADCAST_SLEEP)

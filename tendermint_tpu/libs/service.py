@@ -61,6 +61,10 @@ class Service:
         return task
 
     def _on_task_done(self, task: asyncio.Task) -> None:
+        try:
+            self._tasks.remove(task)
+        except ValueError:
+            pass
         if task.cancelled():
             return
         exc = task.exception()

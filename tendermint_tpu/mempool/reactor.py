@@ -1,0 +1,114 @@
+"""Mempool gossip reactor (reference internal/mempool/v1/reactor.go,
+channel 0x30).
+
+Each peer gets a broadcast task walking the mempool in priority order and
+sending txs that peer hasn't been seen to have (either from us earlier or
+because the peer itself sent it to us — tracked in WrappedTx.peers)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..libs import protoenc as pe
+from ..libs.service import Service
+from ..p2p.peermanager import PeerStatus
+from ..p2p.router import Channel
+from ..p2p.types import Envelope, PeerError
+from . import MEMPOOL_CHANNEL
+from .pool import PriorityMempool, TxInCacheError, TxRejectedError
+
+BROADCAST_SLEEP = 0.05
+
+
+def encode_txs(txs: list[bytes]) -> bytes:
+    return b"".join(pe.bytes_field(1, tx) for tx in txs)
+
+
+def decode_txs(data: bytes) -> list[bytes]:
+    r = pe.Reader(data)
+    out = []
+    while not r.eof():
+        f, wt = r.read_tag()
+        if f == 1:
+            out.append(r.read_bytes())
+        else:
+            r.skip(wt)
+    return out
+
+
+class MempoolReactor(Service):
+    def __init__(
+        self,
+        mempool: PriorityMempool,
+        channel: Channel,
+        peer_updates: asyncio.Queue,
+        *,
+        broadcast: bool = True,
+        logger: logging.Logger | None = None,
+    ):
+        super().__init__("mp-reactor", logger)
+        self.mempool = mempool
+        self.channel = channel
+        self.peer_updates = peer_updates
+        self.broadcast = broadcast
+        self._peer_tasks: dict[str, asyncio.Task] = {}
+        self._sent: dict[str, set[bytes]] = {}  # peer -> tx hashes sent
+
+    async def on_start(self) -> None:
+        self.spawn(self._process_peer_updates(), name="mpr.peers")
+        self.spawn(self._process_inbound(), name="mpr.in")
+
+    async def on_stop(self) -> None:
+        for t in self._peer_tasks.values():
+            t.cancel()
+
+    async def _process_peer_updates(self) -> None:
+        while True:
+            upd = await self.peer_updates.get()
+            if upd.status == PeerStatus.UP and self.broadcast:
+                if upd.node_id not in self._peer_tasks:
+                    self._sent[upd.node_id] = set()
+                    self._peer_tasks[upd.node_id] = self.spawn(
+                        self._broadcast_to(upd.node_id),
+                        name=f"mpr.bcast.{upd.node_id[:8]}",
+                    )
+            elif upd.status == PeerStatus.DOWN:
+                t = self._peer_tasks.pop(upd.node_id, None)
+                if t is not None:
+                    t.cancel()
+                self._sent.pop(upd.node_id, None)
+
+    async def _process_inbound(self) -> None:
+        async for env in self.channel:
+            for tx in env.message:
+                try:
+                    await self.mempool.check_tx(tx, sender=env.from_)
+                except TxInCacheError:
+                    pass
+                except TxRejectedError:
+                    pass  # invalid per app: not the peer's fault per se
+                except Exception as e:
+                    await self.channel.error(PeerError(env.from_, f"tx: {e!r}"))
+
+    async def _broadcast_to(self, peer_id: str) -> None:
+        """Reference broadcastTxRoutine: walk resident txs, skip ones the
+        peer already has."""
+        sent = self._sent[peer_id]
+        while True:
+            batch, hashes = [], []
+            for wtx in self.mempool.all_entries():
+                if wtx.hash in sent or peer_id in wtx.peers:
+                    continue
+                batch.append(wtx.tx)
+                hashes.append(wtx.hash)
+                if len(batch) >= 100:
+                    break
+            if batch:
+                # awaited put: backpressure instead of silent tx loss
+                await self.channel.out_q.put(
+                    Envelope(MEMPOOL_CHANNEL, batch, to=peer_id)
+                )
+                sent.update(hashes)
+            else:
+                await asyncio.sleep(BROADCAST_SLEEP)

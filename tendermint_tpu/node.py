@@ -1,0 +1,301 @@
+"""Node assembly (reference node/node.go:122 makeNode + node/setup.go).
+
+Wires the full stack: stores → ABCI handshake → mempool/evidence pools →
+block executor → consensus SM → reactors (consensus, mempool, evidence,
+blocksync) → router over transports. Startup follows the reference's
+sync path (node.go:597 OnStart): if block-sync is enabled the node first
+replays blocks from peers (range-batched TPU verification) and switches
+to live consensus once caught up (blocksync reactor.go:497-504
+SwitchToConsensus)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+
+from .abci.application import Application
+from .blocksync import BLOCKSYNC_CHANNEL
+from .blocksync import messages as bs_msgs
+from .blocksync.reactor import BlockSyncReactor
+from .config import ConsensusConfig, MempoolConfig
+from .consensus import messages as cs_msgs
+from .consensus.reactor import (
+    DATA_CHANNEL,
+    STATE_CHANNEL,
+    VOTE_CHANNEL,
+    VOTE_SET_BITS_CHANNEL,
+    ConsensusReactor,
+)
+from .consensus.replay import Handshaker
+from .consensus.state import ConsensusState
+from .consensus.wal import WAL
+from .crypto import ed25519
+from .evidence import EVIDENCE_CHANNEL
+from .evidence.pool import EvidencePool
+from .evidence.reactor import EvidenceReactor
+from .libs.service import Service
+from .mempool import MEMPOOL_CHANNEL
+from .mempool.pool import PriorityMempool
+from .mempool.reactor import MempoolReactor, decode_txs, encode_txs
+from .p2p.peermanager import PeerManager
+from .p2p.router import Router
+from .p2p.transport import Transport
+from .p2p.types import NodeInfo, node_id_from_pubkey
+from .privval import PrivValidator
+from .proxy import AppConns
+from .state.execution import BlockExecutor
+from .state.state import state_from_genesis
+from .state.store import StateStore
+from .store.blockstore import BlockStore
+from .store.db import DB, MemDB
+from .types.events import EventBus
+from .types.evidence import decode_evidence
+from .types.genesis import GenesisDoc
+
+
+@dataclass
+class NodeConfig:
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    block_sync: bool = True
+    moniker: str = ""
+    wal_dir: str = ""
+
+
+class Node(Service):
+    """A full node: everything between the wire and the ABCI app."""
+
+    def __init__(
+        self,
+        config: NodeConfig,
+        genesis: GenesisDoc,
+        app: Application,
+        node_key: ed25519.Ed25519PrivKey,
+        transports: list[Transport],
+        *,
+        priv_validator: PrivValidator | None = None,
+        block_db: DB | None = None,
+        state_db: DB | None = None,
+        evidence_db: DB | None = None,
+        logger: logging.Logger | None = None,
+    ):
+        super().__init__("node", logger)
+        self.config = config
+        self.genesis = genesis
+        self.app_conns = AppConns.local(app)
+        self.node_key = node_key
+        self.node_id = node_id_from_pubkey(node_key.pub_key())
+        self.priv_validator = priv_validator
+
+        self.block_store = BlockStore(block_db or MemDB())
+        self.state_store = StateStore(state_db or MemDB())
+        self.evidence_db = evidence_db or MemDB()
+        self.event_bus = EventBus()
+
+        self.node_info = NodeInfo(
+            node_id=self.node_id,
+            network=genesis.chain_id,
+            moniker=config.moniker or self.node_id[:8],
+        )
+        self.peer_manager = PeerManager(self.node_id)
+        self.router = Router(
+            self.node_info, self.node_key, self.peer_manager, transports
+        )
+        self._open_channels()
+
+        # wired in on_start (needs the ABCI handshake first)
+        self.consensus: ConsensusState | None = None
+        self.cs_reactor: ConsensusReactor | None = None
+        self.mempool: PriorityMempool | None = None
+        self.mempool_reactor: MempoolReactor | None = None
+        self.evidence_pool: EvidencePool | None = None
+        self.evidence_reactor: EvidenceReactor | None = None
+        self.blocksync_reactor: BlockSyncReactor | None = None
+        self.state = None
+
+    # -- channels --------------------------------------------------------
+
+    def _open_channels(self) -> None:
+        r = self.router
+        self.state_ch = r.open_channel(
+            STATE_CHANNEL, name="cs-state", priority=6,
+            encode=cs_msgs.encode_message, decode=cs_msgs.decode_message,
+        )
+        self.data_ch = r.open_channel(
+            DATA_CHANNEL, name="cs-data", priority=10,
+            encode=cs_msgs.encode_message, decode=cs_msgs.decode_message,
+        )
+        self.vote_ch = r.open_channel(
+            VOTE_CHANNEL, name="cs-vote", priority=7,
+            encode=cs_msgs.encode_message, decode=cs_msgs.decode_message,
+        )
+        self.bits_ch = r.open_channel(
+            VOTE_SET_BITS_CHANNEL, name="cs-bits", priority=1,
+            encode=cs_msgs.encode_message, decode=cs_msgs.decode_message,
+        )
+        self.mempool_ch = r.open_channel(
+            MEMPOOL_CHANNEL, name="mempool", priority=5,
+            encode=encode_txs, decode=decode_txs,
+        )
+        self.evidence_ch = r.open_channel(
+            EVIDENCE_CHANNEL, name="evidence", priority=6,
+            encode=lambda ev: ev.encode(), decode=decode_evidence,
+        )
+        self.blocksync_ch = r.open_channel(
+            BLOCKSYNC_CHANNEL, name="blocksync", priority=5,
+            encode=bs_msgs.encode_message, decode=bs_msgs.decode_message,
+        )
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def on_start(self) -> None:
+        await self.app_conns.start()
+        state = self.state_store.load()
+        if state is None:
+            state = state_from_genesis(self.genesis)
+        handshaker = Handshaker(
+            self.state_store, state, self.block_store, self.genesis,
+            logger=self.logger.getChild("handshake"),
+        )
+        self.state = await handshaker.handshake(self.app_conns)
+        self.state_store.save(self.state)
+
+        self.mempool = PriorityMempool(
+            self.config.mempool,
+            self.app_conns.mempool,
+            height=self.state.last_block_height,
+        )
+        self.evidence_pool = EvidencePool(
+            self.evidence_db, self.state_store, self.block_store
+        )
+        block_exec = BlockExecutor(
+            self.state_store,
+            self.app_conns.consensus,
+            mempool=self.mempool,
+            evidence_pool=self.evidence_pool,
+            block_store=self.block_store,
+            event_bus=self.event_bus,
+        )
+        import tempfile
+
+        wal = WAL(self.config.wal_dir or tempfile.mkdtemp(prefix="cswal-"))
+        self.consensus = ConsensusState(
+            self.config.consensus,
+            self.state,
+            block_exec,
+            self.block_store,
+            priv_validator=self.priv_validator,
+            evidence_pool=self.evidence_pool,
+            wal=wal,
+            event_bus=self.event_bus,
+        )
+        self.cs_reactor = ConsensusReactor(
+            self.consensus,
+            self.state_ch,
+            self.data_ch,
+            self.vote_ch,
+            self.bits_ch,
+            self.peer_manager.subscribe(),
+        )
+        self.mempool_reactor = MempoolReactor(
+            self.mempool, self.mempool_ch, self.peer_manager.subscribe()
+        )
+        self.evidence_reactor = EvidenceReactor(
+            self.evidence_pool, self.evidence_ch, self.peer_manager.subscribe()
+        )
+        self.blocksync_reactor = BlockSyncReactor(
+            self.state,
+            block_exec,
+            self.block_store,
+            self.blocksync_ch,
+            self.peer_manager.subscribe(),
+            active=self.config.block_sync,
+        )
+
+        await self.router.start()
+        await self.mempool_reactor.start()
+        await self.evidence_reactor.start()
+        await self.blocksync_reactor.start()
+        if self.config.block_sync:
+            self.spawn(self._wait_for_sync(), name="node.syncwait")
+        else:
+            await self._start_consensus()
+
+    # consensus falling this far behind the best peer triggers a switch
+    # back to block-sync (vote gossip can't close unbounded gaps)
+    LAG_SWITCH_THRESHOLD = 64
+
+    async def _wait_for_sync(self) -> None:
+        """Block-sync until caught up, then switch to consensus
+        (reference SwitchToConsensus)."""
+        await self.blocksync_reactor.synced.wait()
+        # adopt the synced state
+        synced_state = self.blocksync_reactor.state
+        if synced_state.last_block_height > self.state.last_block_height:
+            self.state = synced_state
+        self.logger.info(
+            "block-sync caught up at height %d; switching to consensus",
+            self.state.last_block_height,
+        )
+        await self._start_consensus()
+        self.spawn(self._lag_monitor(), name="node.lag")
+
+    async def _lag_monitor(self) -> None:
+        """If live consensus falls far behind the best peer, pause it and
+        re-run the block-sync pipeline (reference 0.37+ switch-back)."""
+        while True:
+            await asyncio.sleep(2.0)
+            bs = self.blocksync_reactor
+            if bs is None or self.consensus is None or not bs.synced.is_set():
+                continue
+            lag = bs.pool.max_peer_height() - self.block_store.height()
+            if lag <= self.LAG_SWITCH_THRESHOLD:
+                continue
+            self.logger.info(
+                "consensus fell %d blocks behind; switching back to block-sync", lag
+            )
+            self.consensus.pause()
+            state = self.state_store.load() or self.state
+            bs.resume(state)
+            await bs.synced.wait()
+            self.state = bs.state
+            self.logger.info(
+                "re-synced to height %d; resuming consensus",
+                self.state.last_block_height,
+            )
+            self.consensus.resume_with_state(self.state)
+
+    async def _start_consensus(self) -> None:
+        latest = self.state_store.load()
+        if latest is not None and latest.last_block_height > self.consensus.rs.height - 1:
+            self.consensus.update_to_state(latest)
+        await self.cs_reactor.start()
+        await self.consensus.start()
+
+    async def on_stop(self) -> None:
+        for svc in (
+            self.cs_reactor,
+            self.consensus,
+            self.blocksync_reactor,
+            self.evidence_reactor,
+            self.mempool_reactor,
+            self.router,
+        ):
+            if svc is not None:
+                try:
+                    await svc.stop()
+                except Exception:
+                    pass
+        await self.app_conns.stop()
+
+    # -- convenience -----------------------------------------------------
+
+    async def wait_for_height(self, height: int, timeout: float = 60.0) -> None:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while self.block_store.height() < height:
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(
+                    f"node {self.node_id[:8]} stuck at {self.block_store.height()}"
+                )
+            await asyncio.sleep(0.05)

@@ -378,6 +378,13 @@ class Block:
     def block_id(self, part_set_header: PartSetHeader) -> BlockID:
         return BlockID(self.hash(), part_set_header)
 
+    def make_part_set(self, part_size: int | None = None):
+        """Split into 64KB merkle-proved parts (reference
+        types/block.go MakePartSet)."""
+        from .part_set import BLOCK_PART_SIZE, PartSet
+
+        return PartSet.from_data(self.encode(), part_size or BLOCK_PART_SIZE)
+
     def encode(self) -> bytes:
         out = pe.message_field(1, self.header.encode())
         for tx in self.txs:

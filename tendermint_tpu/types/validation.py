@@ -193,6 +193,65 @@ def _verify_batch(
         raise InvalidCommitError("batch verification failed")
 
 
+def verify_commit_range(
+    chain_id: str,
+    entries: list[tuple[ValidatorSet, BlockID, int, Commit]],
+) -> None:
+    """Cross-commit mega-batching (SURVEY.md §5 "long-context" analog):
+    verify a RANGE of commits — e.g. a block-sync window — in ONE batch
+    verifier call, so hundreds of heights' signatures form a single TPU
+    kernel launch instead of one launch per block.
+
+    Each entry is (validator_set, block_id, height, commit), light
+    semantics per commit (+2/3 of block signatures, early cut-off). On a
+    batch failure, falls back to per-commit verification to pinpoint the
+    offender — so the error surface matches verify_commit_light called
+    per entry. Raises InvalidCommitError carrying `failed_index` (the
+    entry index) on failure."""
+    if not entries:
+        return
+    bv = crypto_batch.create_batch_verifier(entries[0][0].validators[0].pub_key)
+    added_any = False
+    for ei, (vals, block_id, height, commit) in enumerate(entries):
+        try:
+            _basic_commit_checks(vals, block_id, height, commit)
+            if not _should_batch_verify(vals, commit):
+                # mixed/secp256k1 sets: verify this one individually
+                verify_commit_light(chain_id, vals, block_id, height, commit)
+                continue
+            voting_power_needed = vals.total_voting_power() * 2 // 3
+            tallied = 0
+            for idx, cs, val in _iter_entries(vals, commit, lookup_by_index=True):
+                if not cs.is_commit():
+                    continue
+                bv.add(val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature)
+                added_any = True
+                tallied += val.voting_power
+                if tallied > voting_power_needed:
+                    break
+            if tallied <= voting_power_needed:
+                raise InvalidCommitError(
+                    f"insufficient voting power at height {height}: "
+                    f"got {tallied}, need > {voting_power_needed}"
+                )
+        except InvalidCommitError as e:
+            e.failed_index = ei
+            raise
+    if not added_any:
+        return
+    ok, _bitmap = bv.verify()
+    if ok:
+        return
+    # locate the offending commit: per-commit fallback
+    for ei, (vals, block_id, height, commit) in enumerate(entries):
+        try:
+            verify_commit_light(chain_id, vals, block_id, height, commit)
+        except InvalidCommitError as e:
+            e.failed_index = ei
+            raise
+    raise InvalidCommitError("range batch failed but all commits verify singly")
+
+
 def _verify_single(
     chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
 ) -> None:
