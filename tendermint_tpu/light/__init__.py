@@ -1,0 +1,9 @@
+"""Light client (reference light/): verify headers against a trusted
+header using validator-set overlap instead of replaying the chain. The
+stateless core verifier is light/verifier.py; the stateful client with
+bisection, a pluggable trusted store, and witness cross-checking is
+light/client.py."""
+
+from .types import LightBlock, SignedHeader
+
+__all__ = ["LightBlock", "SignedHeader"]

@@ -1,0 +1,258 @@
+"""Light client (reference light/client.go:127).
+
+Holds a trusted store of verified LightBlocks, a primary provider, and
+witness providers. `verify_light_block_at_height` verifies forward via
+sequential or skipping (bisection) verification — skipping needs only
+log(n) headers thanks to the 1/3-overlap rule — or backwards via hash
+linkage (client.go:878). After primary verification the header is cross-
+checked against witnesses; a mismatch raises Divergence (the detector,
+light/detector.go:28)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from fractions import Fraction
+
+from ..store.db import DB, MemDB
+from . import verifier
+from .provider import LightBlockNotFoundError, Provider, ProviderError
+from .types import LightBlock
+from .verifier import ErrNewValSetCantBeTrusted, VerificationError
+
+_LB_PREFIX = b"lb/"
+
+
+@dataclass(frozen=True)
+class TrustOptions:
+    """How the client bootstraps trust (reference light/client.go
+    TrustOptions): a header hash the user got out of band."""
+
+    period_ns: int
+    height: int
+    hash: bytes
+
+
+class Divergence(Exception):
+    """A witness provided a conflicting verified header (light-client
+    attack in progress; reference detector.go)."""
+
+    def __init__(self, witness: Provider, trace: list[LightBlock], challenging: LightBlock):
+        super().__init__(
+            f"witness {witness!r} diverged at height {challenging.height}"
+        )
+        self.witness = witness
+        self.trace = trace
+        self.challenging = challenging
+
+
+class TrustedStore:
+    """Persisted verified light blocks (reference light/store/db)."""
+
+    def __init__(self, db: DB | None = None):
+        self.db = db or MemDB()
+
+    def save(self, lb: LightBlock) -> None:
+        self.db.set(_LB_PREFIX + lb.height.to_bytes(8, "big"), lb.encode())
+
+    def get(self, height: int) -> LightBlock | None:
+        raw = self.db.get(_LB_PREFIX + height.to_bytes(8, "big"))
+        return LightBlock.decode(raw) if raw is not None else None
+
+    def latest(self) -> LightBlock | None:
+        for _k, raw in self.db.iterate(
+            _LB_PREFIX, _LB_PREFIX + b"\xff" * 8, reverse=True
+        ):
+            return LightBlock.decode(raw)
+        return None
+
+    def lowest(self) -> LightBlock | None:
+        for _k, raw in self.db.iterate(_LB_PREFIX, _LB_PREFIX + b"\xff" * 8):
+            return LightBlock.decode(raw)
+        return None
+
+    def prune(self, keep: int) -> None:
+        keys = [k for k, _ in self.db.iterate(_LB_PREFIX, _LB_PREFIX + b"\xff" * 8)]
+        for k in keys[:-keep] if keep else keys:
+            self.db.delete(k)
+
+
+class LightClient:
+    def __init__(
+        self,
+        chain_id: str,
+        trust_options: TrustOptions,
+        primary: Provider,
+        witnesses: list[Provider] | None = None,
+        *,
+        store: TrustedStore | None = None,
+        trust_level: Fraction = verifier.DEFAULT_TRUST_LEVEL,
+        sequential: bool = False,
+        logger: logging.Logger | None = None,
+    ):
+        self.chain_id = chain_id
+        self.trust_options = trust_options
+        self.primary = primary
+        self.witnesses = list(witnesses or [])
+        self.store = store or TrustedStore()
+        self.trust_level = trust_level
+        self.sequential = sequential
+        self.logger = logger or logging.getLogger("light")
+
+    # -- bootstrap -------------------------------------------------------
+
+    async def initialize(self) -> LightBlock:
+        """Fetch + pin the trust-options header (reference
+        client.go:311 initializeWithTrustOptions)."""
+        lb = await self.primary.light_block(self.trust_options.height)
+        lb.validate_basic(self.chain_id)
+        if lb.header.hash() != self.trust_options.hash:
+            raise VerificationError(
+                f"trusted header hash mismatch at height {lb.height}: "
+                f"{lb.header.hash().hex()} != {self.trust_options.hash.hex()}"
+            )
+        # the commit must actually be signed by the block's validator set
+        from ..types.validation import verify_commit_light
+
+        verify_commit_light(
+            self.chain_id,
+            lb.validators,
+            lb.signed_header.commit.block_id,
+            lb.height,
+            lb.signed_header.commit,
+        )
+        self.store.save(lb)
+        return lb
+
+    # -- main entry ------------------------------------------------------
+
+    async def verify_light_block_at_height(
+        self, height: int, now_ns: int | None = None
+    ) -> LightBlock:
+        """Reference VerifyLightBlockAtHeight client.go:406."""
+        now_ns = time.time_ns() if now_ns is None else now_ns
+        existing = self.store.get(height) if height else None
+        if existing is not None:
+            return existing
+        latest = self.store.latest()
+        if latest is None:
+            latest = await self.initialize()
+        target = await self.primary.light_block(height)
+        if target.height < latest.height:
+            verified = await self._verify_backwards(target, latest)
+        elif self.sequential:
+            verified = await self._verify_sequential(latest, target, now_ns)
+        else:
+            verified = await self._verify_skipping(latest, target, now_ns)
+        await self._detect_divergence(verified, now_ns)
+        self.store.save(verified)
+        return verified
+
+    async def update(self, now_ns: int | None = None) -> LightBlock:
+        """Verify the primary's latest header (reference client.go Update)."""
+        latest = await self.primary.light_block(0)
+        return await self.verify_light_block_at_height(latest.height, now_ns)
+
+    # -- strategies ------------------------------------------------------
+
+    async def _verify_sequential(
+        self, trusted: LightBlock, target: LightBlock, now_ns: int
+    ) -> LightBlock:
+        """Reference verifySequential client.go:546."""
+        for h in range(trusted.height + 1, target.height + 1):
+            lb = target if h == target.height else await self.primary.light_block(h)
+            verifier.verify_adjacent(
+                self.chain_id, trusted, lb, self.trust_options.period_ns, now_ns
+            )
+            self.store.save(lb)
+            trusted = lb
+        return trusted
+
+    async def _verify_skipping(
+        self, trusted: LightBlock, target: LightBlock, now_ns: int
+    ) -> LightBlock:
+        """Bisection (reference verifySkipping client.go:639): try to jump
+        straight to the target; on 1/3-overlap failure, bisect."""
+        pending = [target]
+        while pending:
+            lb = pending[-1]
+            try:
+                verifier.verify(
+                    self.chain_id,
+                    trusted,
+                    lb,
+                    self.trust_options.period_ns,
+                    now_ns,
+                    trust_level=self.trust_level,
+                )
+            except ErrNewValSetCantBeTrusted:
+                mid = (trusted.height + lb.height) // 2
+                if mid in (trusted.height, lb.height):
+                    raise VerificationError(
+                        "bisection cannot make progress (validator sets too disjoint)"
+                    )
+                pending.append(await self.primary.light_block(mid))
+                continue
+            self.store.save(lb)
+            trusted = lb
+            pending.pop()
+        return trusted
+
+    async def _verify_backwards(
+        self, target: LightBlock, trusted: LightBlock
+    ) -> LightBlock:
+        """Hash-linkage verification for heights below the trusted head
+        (reference client.go:878): walk last_block_id back to the target."""
+        cur = trusted
+        while cur.height > target.height:
+            prev_height = cur.height - 1
+            prev = (
+                target
+                if prev_height == target.height
+                else await self.primary.light_block(prev_height)
+            )
+            prev.validate_basic(self.chain_id)
+            if cur.header.last_block_id.hash != prev.header.hash():
+                raise VerificationError(
+                    f"backwards verification failed at height {prev_height}: "
+                    "hash chain broken"
+                )
+            self.store.save(prev)
+            cur = prev
+        return cur
+
+    # -- witness cross-check --------------------------------------------
+
+    async def _detect_divergence(self, verified: LightBlock, now_ns: int) -> None:
+        """Compare the newly verified header against every witness
+        (reference detector.go:28 detectDivergence). A witness that
+        serves a DIFFERENT header for the same height with a valid
+        commit is evidence of an attack."""
+        if not self.witnesses:
+            return
+        for witness in list(self.witnesses):
+            try:
+                w_lb = await witness.light_block(verified.height)
+            except (ProviderError, LightBlockNotFoundError):
+                continue  # witness lagging; not divergence
+            if w_lb.header.hash() == verified.header.hash():
+                continue
+            # conflicting header — check it's actually signed (i.e. a
+            # real attack, not witness garbage)
+            try:
+                w_lb.validate_basic(self.chain_id)
+                from ..types.validation import verify_commit_light
+
+                verify_commit_light(
+                    self.chain_id,
+                    w_lb.validators,
+                    w_lb.signed_header.commit.block_id,
+                    w_lb.height,
+                    w_lb.signed_header.commit,
+                )
+            except (ValueError, VerificationError):
+                self.logger.info("dropping bad witness %r", witness)
+                self.witnesses.remove(witness)
+                continue
+            raise Divergence(witness, [verified], w_lb)

@@ -1,0 +1,63 @@
+"""Light-block providers (reference light/provider/provider.go).
+
+A provider serves LightBlocks for heights and accepts evidence reports.
+`BlockStoreProvider` is the in-process implementation over a node's
+stores (the analog of the reference's local provider used by tests and
+the statesync backfill); the RPC-backed provider lives with the RPC
+client (task: rpc layer)."""
+
+from __future__ import annotations
+
+from ..types.block import Commit
+from .types import LightBlock, SignedHeader
+
+
+class ProviderError(Exception):
+    pass
+
+
+class LightBlockNotFoundError(ProviderError):
+    pass
+
+
+class Provider:
+    def chain_id(self) -> str:
+        raise NotImplementedError
+
+    async def light_block(self, height: int) -> LightBlock:
+        """Height 0 = latest. Raises LightBlockNotFoundError."""
+        raise NotImplementedError
+
+    async def report_evidence(self, evidence) -> None:
+        raise NotImplementedError
+
+
+class BlockStoreProvider(Provider):
+    """Serve light blocks straight from a block store + state store."""
+
+    def __init__(self, chain_id: str, block_store, state_store):
+        self._chain_id = chain_id
+        self.block_store = block_store
+        self.state_store = state_store
+        self.reported: list = []
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    def _light_block_sync(self, height: int) -> LightBlock:
+        if height == 0:
+            height = self.block_store.height()
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)  # commit FOR height
+        if commit is None:
+            commit = self.block_store.load_seen_commit(height)  # tip block
+        vals = self.state_store.load_validators(height)
+        if meta is None or commit is None or vals is None:
+            raise LightBlockNotFoundError(f"no light block at height {height}")
+        return LightBlock(SignedHeader(meta.header, commit), vals)
+
+    async def light_block(self, height: int) -> LightBlock:
+        return self._light_block_sync(height)
+
+    async def report_evidence(self, evidence) -> None:
+        self.reported.append(evidence)

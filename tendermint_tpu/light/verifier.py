@@ -1,0 +1,151 @@
+"""Stateless light-client core verifier (reference light/verifier.go).
+
+Two modes:
+  verify_adjacent (verifier.go:103) — heights h, h+1: the trusted header
+    pins the EXACT next validator set (next_validators_hash), so only
+    VerifyCommitLight against that set is needed.
+  verify_non_adjacent (verifier.go:33) — skipping/bisection: the trusted
+    set must still hold `trust_level` (default 1/3) of the new commit's
+    power (VerifyCommitLightTrusting), then the new set verifies its own
+    commit (VerifyCommitLight).
+
+Both funnel into the same batched TPU verification path
+(types/validation.py)."""
+
+from __future__ import annotations
+
+import time
+from fractions import Fraction
+
+from ..types.validation import (
+    InvalidCommitError,
+    verify_commit_light,
+    verify_commit_light_trusting,
+)
+from .types import LightBlock
+
+DEFAULT_TRUST_LEVEL = Fraction(1, 3)
+
+
+class VerificationError(ValueError):
+    pass
+
+
+class ErrNewValSetCantBeTrusted(VerificationError):
+    """Trusting-period overlap check failed — caller should bisect
+    (reference ErrNewValSetCantBeTrusted)."""
+
+
+def _validate_untrusted(
+    chain_id: str,
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    now_ns: int,
+    max_clock_drift_ns: int,
+) -> None:
+    """Shared sanity checks (reference verifier.go
+    checkRequiredHeaderFields + verifyNewHeaderAndVals)."""
+    untrusted.validate_basic(chain_id)
+    if untrusted.height <= trusted.height:
+        raise VerificationError(
+            f"untrusted height {untrusted.height} <= trusted {trusted.height}"
+        )
+    if untrusted.header.time_ns <= trusted.header.time_ns:
+        raise VerificationError("untrusted header time is not after trusted")
+    if untrusted.header.time_ns >= now_ns + max_clock_drift_ns:
+        raise VerificationError("untrusted header time is from the future")
+
+
+def _expired(trusted: LightBlock, trusting_period_ns: int, now_ns: int) -> bool:
+    return trusted.header.time_ns + trusting_period_ns <= now_ns
+
+
+def verify_adjacent(
+    chain_id: str,
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int | None = None,
+    max_clock_drift_ns: int = 10 * 1_000_000_000,
+) -> None:
+    """Reference VerifyAdjacent verifier.go:103."""
+    now_ns = time.time_ns() if now_ns is None else now_ns
+    if untrusted.height != trusted.height + 1:
+        raise VerificationError("headers must be adjacent in height")
+    if _expired(trusted, trusting_period_ns, now_ns):
+        raise VerificationError("trusted header has expired")
+    _validate_untrusted(chain_id, trusted, untrusted, now_ns, max_clock_drift_ns)
+    if untrusted.header.validators_hash != trusted.header.next_validators_hash:
+        raise VerificationError(
+            "untrusted validators hash != trusted next_validators_hash"
+        )
+    try:
+        verify_commit_light(
+            chain_id,
+            untrusted.validators,
+            untrusted.signed_header.commit.block_id,
+            untrusted.height,
+            untrusted.signed_header.commit,
+        )
+    except InvalidCommitError as e:
+        raise VerificationError(f"invalid commit: {e}") from e
+
+
+def verify_non_adjacent(
+    chain_id: str,
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int | None = None,
+    max_clock_drift_ns: int = 10 * 1_000_000_000,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+) -> None:
+    """Reference VerifyNonAdjacent verifier.go:33."""
+    now_ns = time.time_ns() if now_ns is None else now_ns
+    if untrusted.height == trusted.height + 1:
+        return verify_adjacent(
+            chain_id, trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
+        )
+    if _expired(trusted, trusting_period_ns, now_ns):
+        raise VerificationError("trusted header has expired")
+    _validate_untrusted(chain_id, trusted, untrusted, now_ns, max_clock_drift_ns)
+    # the trusted validator set must still control trust_level of the new
+    # commit (verifier.go:67)
+    try:
+        verify_commit_light_trusting(
+            chain_id,
+            trusted.validators,
+            untrusted.signed_header.commit,
+            trust_level,
+        )
+    except InvalidCommitError as e:
+        raise ErrNewValSetCantBeTrusted(str(e)) from e
+    # and the new set must verify its own commit (verifier.go:82)
+    try:
+        verify_commit_light(
+            chain_id,
+            untrusted.validators,
+            untrusted.signed_header.commit.block_id,
+            untrusted.height,
+            untrusted.signed_header.commit,
+        )
+    except InvalidCommitError as e:
+        raise VerificationError(f"invalid commit: {e}") from e
+
+
+def verify(
+    chain_id: str,
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int | None = None,
+    trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+) -> None:
+    """Dispatch on adjacency (reference Verify verifier.go:151)."""
+    if untrusted.height == trusted.height + 1:
+        verify_adjacent(chain_id, trusted, untrusted, trusting_period_ns, now_ns)
+    else:
+        verify_non_adjacent(
+            chain_id, trusted, untrusted, trusting_period_ns, now_ns,
+            trust_level=trust_level,
+        )

@@ -44,6 +44,14 @@ from .p2p.transport import Transport
 from .p2p.types import NodeInfo, node_id_from_pubkey
 from .privval import PrivValidator
 from .proxy import AppConns
+from .statesync import (
+    CHUNK_CHANNEL,
+    LIGHT_BLOCK_CHANNEL,
+    PARAMS_CHANNEL,
+    SNAPSHOT_CHANNEL,
+)
+from .statesync import messages as ss_msgs
+from .statesync.reactor import StateSyncReactor, SyncConfig
 from .state.execution import BlockExecutor
 from .state.state import state_from_genesis
 from .state.store import StateStore
@@ -59,6 +67,9 @@ class NodeConfig:
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     block_sync: bool = True
+    # when set and the node is at genesis, restore from an app snapshot
+    # before block-syncing (reference config statesync.enable)
+    state_sync: SyncConfig | None = None
     moniker: str = ""
     wal_dir: str = ""
 
@@ -112,6 +123,7 @@ class Node(Service):
         self.evidence_pool: EvidencePool | None = None
         self.evidence_reactor: EvidenceReactor | None = None
         self.blocksync_reactor: BlockSyncReactor | None = None
+        self.statesync_reactor: StateSyncReactor | None = None
         self.state = None
 
     # -- channels --------------------------------------------------------
@@ -146,6 +158,20 @@ class Node(Service):
             BLOCKSYNC_CHANNEL, name="blocksync", priority=5,
             encode=bs_msgs.encode_message, decode=bs_msgs.decode_message,
         )
+        for cid, name in (
+            (SNAPSHOT_CHANNEL, "ss-snapshot"),
+            (CHUNK_CHANNEL, "ss-chunk"),
+            (LIGHT_BLOCK_CHANNEL, "ss-lb"),
+            (PARAMS_CHANNEL, "ss-params"),
+        ):
+            setattr(
+                self,
+                name.replace("-", "_") + "_ch",
+                r.open_channel(
+                    cid, name=name, priority=3,
+                    encode=ss_msgs.encode_message, decode=ss_msgs.decode_message,
+                ),
+            )
 
     # -- lifecycle -------------------------------------------------------
 
@@ -213,14 +239,45 @@ class Node(Service):
             active=self.config.block_sync,
         )
 
+        self.statesync_reactor = StateSyncReactor(
+            self.genesis.chain_id,
+            self.app_conns,
+            self.state_store,
+            self.block_store,
+            self.ss_snapshot_ch,
+            self.ss_chunk_ch,
+            self.ss_lb_ch,
+            self.ss_params_ch,
+            self.peer_manager.subscribe(),
+        )
+
         await self.router.start()
         await self.mempool_reactor.start()
         await self.evidence_reactor.start()
-        await self.blocksync_reactor.start()
-        if self.config.block_sync:
-            self.spawn(self._wait_for_sync(), name="node.syncwait")
+        await self.statesync_reactor.start()
+        if (
+            self.config.state_sync is not None
+            and self.state.last_block_height == 0
+        ):
+            self.spawn(self._run_state_sync(), name="node.statesync")
         else:
-            await self._start_consensus()
+            await self.blocksync_reactor.start()
+            if self.config.block_sync:
+                self.spawn(self._wait_for_sync(), name="node.syncwait")
+            else:
+                await self._start_consensus()
+
+    async def _run_state_sync(self) -> None:
+        """Snapshot restore, then block-sync the gap, then consensus
+        (reference OnStart stateSync branch node.go:597)."""
+        state = await self.statesync_reactor.sync(self.config.state_sync)
+        self.state = state
+        # blocksync reactor was constructed against the genesis state;
+        # re-point it at the restored one
+        self.blocksync_reactor.state = state
+        self.blocksync_reactor.pool.height = state.last_block_height + 1
+        await self.blocksync_reactor.start()
+        self.spawn(self._wait_for_sync(), name="node.syncwait")
 
     # consensus falling this far behind the best peer triggers a switch
     # back to block-sync (vote gossip can't close unbounded gaps)
@@ -278,6 +335,7 @@ class Node(Service):
             self.cs_reactor,
             self.consensus,
             self.blocksync_reactor,
+            self.statesync_reactor,
             self.evidence_reactor,
             self.mempool_reactor,
             self.router,

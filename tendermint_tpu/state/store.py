@@ -154,6 +154,10 @@ class StateStore:
         sets.append((_hkey(_PARAMS, height + 1), state.consensus_params.encode()))
         self.db.write_batch(sets)
 
+    def save_validators(self, height: int, vals: ValidatorSet) -> None:
+        """Index a historical validator set directly (statesync backfill)."""
+        self.db.set(_hkey(_VALS, height), vals.encode())
+
     # -- per-height lookups ---------------------------------------------
 
     def load_validators(self, height: int) -> ValidatorSet | None:

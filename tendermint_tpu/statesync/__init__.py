@@ -1,0 +1,14 @@
+"""State sync — bootstrap a fresh node from an application snapshot
+instead of replaying history (reference internal/statesync/).
+
+Four wire channels (reference reactor.go:89-98):
+  0x60 snapshot — discovery (SnapshotsRequest/Response)
+  0x61 chunk — snapshot data transfer
+  0x62 light-block — the p2p state provider's verification source
+  0x63 params — historical consensus params
+"""
+
+SNAPSHOT_CHANNEL = 0x60
+CHUNK_CHANNEL = 0x61
+LIGHT_BLOCK_CHANNEL = 0x62
+PARAMS_CHANNEL = 0x63

@@ -1,0 +1,214 @@
+"""Statesync wire messages (reference proto/tendermint/statesync)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..libs import protoenc as pe
+from ..light.types import LightBlock
+from ..types.params import ConsensusParams
+
+T_SNAPSHOTS_REQUEST = 1
+T_SNAPSHOTS_RESPONSE = 2
+T_CHUNK_REQUEST = 3
+T_CHUNK_RESPONSE = 4
+T_LIGHT_BLOCK_REQUEST = 5
+T_LIGHT_BLOCK_RESPONSE = 6
+T_PARAMS_REQUEST = 7
+T_PARAMS_RESPONSE = 8
+
+
+@dataclass(frozen=True)
+class SnapshotsRequest:
+    pass
+
+
+@dataclass(frozen=True)
+class SnapshotsResponse:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+
+@dataclass(frozen=True)
+class ChunkRequest:
+    height: int
+    format: int
+    index: int
+
+
+@dataclass(frozen=True)
+class ChunkResponse:
+    height: int
+    format: int
+    index: int
+    chunk: bytes = b""
+    missing: bool = False
+
+
+@dataclass(frozen=True)
+class LightBlockRequest:
+    height: int
+
+
+@dataclass(frozen=True)
+class LightBlockResponse:
+    light_block: LightBlock | None  # None = don't have it
+
+
+@dataclass(frozen=True)
+class ParamsRequest:
+    height: int
+
+
+@dataclass(frozen=True)
+class ParamsResponse:
+    height: int
+    params: ConsensusParams | None
+
+
+Message = (
+    SnapshotsRequest
+    | SnapshotsResponse
+    | ChunkRequest
+    | ChunkResponse
+    | LightBlockRequest
+    | LightBlockResponse
+    | ParamsRequest
+    | ParamsResponse
+)
+
+
+def encode_message(msg: Message) -> bytes:
+    if isinstance(msg, SnapshotsRequest):
+        return pe.message_field(T_SNAPSHOTS_REQUEST, b"")
+    if isinstance(msg, SnapshotsResponse):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.format)
+            + pe.varint_field(3, msg.chunks)
+            + pe.bytes_field(4, msg.hash)
+            + pe.bytes_field(5, msg.metadata)
+        )
+        return pe.message_field(T_SNAPSHOTS_RESPONSE, body)
+    if isinstance(msg, ChunkRequest):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.format)
+            + pe.varint_field(3, msg.index)
+        )
+        return pe.message_field(T_CHUNK_REQUEST, body)
+    if isinstance(msg, ChunkResponse):
+        body = (
+            pe.varint_field(1, msg.height)
+            + pe.varint_field(2, msg.format)
+            + pe.varint_field(3, msg.index)
+            + pe.bytes_field(4, msg.chunk)
+            + pe.varint_field(5, 1 if msg.missing else 0)
+        )
+        return pe.message_field(T_CHUNK_RESPONSE, body)
+    if isinstance(msg, LightBlockRequest):
+        return pe.message_field(T_LIGHT_BLOCK_REQUEST, pe.varint_field(1, msg.height))
+    if isinstance(msg, LightBlockResponse):
+        body = b""
+        if msg.light_block is not None:
+            body = pe.message_field(1, msg.light_block.encode())
+        return pe.message_field(T_LIGHT_BLOCK_RESPONSE, body)
+    if isinstance(msg, ParamsRequest):
+        return pe.message_field(T_PARAMS_REQUEST, pe.varint_field(1, msg.height))
+    if isinstance(msg, ParamsResponse):
+        body = pe.varint_field(1, msg.height)
+        if msg.params is not None:
+            body += pe.message_field(2, msg.params.encode())
+        return pe.message_field(T_PARAMS_RESPONSE, body)
+    raise TypeError(f"unknown statesync message {type(msg)}")
+
+
+def decode_message(data: bytes) -> Message:
+    r = pe.Reader(data)
+    f, _wt = r.read_tag()
+    body = r.read_bytes()
+    br = pe.Reader(body)
+    if f == T_SNAPSHOTS_REQUEST:
+        return SnapshotsRequest()
+    if f == T_SNAPSHOTS_RESPONSE:
+        height = fmt = chunks = 0
+        hash_ = metadata = b""
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            elif bf == 2:
+                fmt = br.read_uvarint()
+            elif bf == 3:
+                chunks = br.read_uvarint()
+            elif bf == 4:
+                hash_ = br.read_bytes()
+            elif bf == 5:
+                metadata = br.read_bytes()
+            else:
+                br.skip(bwt)
+        return SnapshotsResponse(height, fmt, chunks, hash_, metadata)
+    if f in (T_CHUNK_REQUEST, T_CHUNK_RESPONSE):
+        height = fmt = index = 0
+        chunk = b""
+        missing = False
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            elif bf == 2:
+                fmt = br.read_uvarint()
+            elif bf == 3:
+                index = br.read_uvarint()
+            elif bf == 4:
+                chunk = br.read_bytes()
+            elif bf == 5:
+                missing = br.read_uvarint() == 1
+            else:
+                br.skip(bwt)
+        if f == T_CHUNK_REQUEST:
+            return ChunkRequest(height, fmt, index)
+        return ChunkResponse(height, fmt, index, chunk, missing)
+    if f == T_LIGHT_BLOCK_REQUEST:
+        height = 0
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            else:
+                br.skip(bwt)
+        return LightBlockRequest(height)
+    if f == T_LIGHT_BLOCK_RESPONSE:
+        lb = None
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                lb = LightBlock.decode(br.read_bytes())
+            else:
+                br.skip(bwt)
+        return LightBlockResponse(lb)
+    if f == T_PARAMS_REQUEST:
+        height = 0
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            else:
+                br.skip(bwt)
+        return ParamsRequest(height)
+    if f == T_PARAMS_RESPONSE:
+        height = 0
+        params = None
+        while not br.eof():
+            bf, bwt = br.read_tag()
+            if bf == 1:
+                height = br.read_uvarint()
+            elif bf == 2:
+                params = ConsensusParams.decode(br.read_bytes())
+            else:
+                br.skip(bwt)
+        return ParamsResponse(height, params)
+    raise ValueError(f"unknown statesync tag {f}")

@@ -1,0 +1,421 @@
+"""Statesync reactor (reference internal/statesync/reactor.go:142).
+
+Serving side: answers snapshot discovery from the app's ListSnapshots,
+chunk requests from LoadSnapshotChunk, light-block requests from the
+local stores, and params requests from the state store.
+
+Syncing side (`sync()`, reference Sync :269 + syncer.go):
+  1. discover snapshots from peers (0x60)
+  2. verify the target height's header via the light client over the
+     p2p light-block channel (0x62) — the state provider
+  3. offer the snapshot to the app; fetch chunks in parallel (0x61);
+     ApplySnapshotChunk until accepted
+  4. verify the app's restored hash against the verified header
+  5. bootstrap State + block store, then Backfill recent headers
+     (hash-chain linked, reference reactor.go:348,481) so evidence
+     verification has history
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from fractions import Fraction
+
+from ..abci import types as abci
+from ..libs.service import Service
+from ..light.client import LightClient, TrustOptions, TrustedStore
+from ..light.provider import LightBlockNotFoundError, Provider
+from ..light.types import LightBlock, SignedHeader
+from ..p2p.peermanager import PeerStatus
+from ..p2p.router import Channel
+from ..p2p.types import Envelope, PeerError
+from ..state.state import State
+from ..types.block import BlockID
+from . import CHUNK_CHANNEL, LIGHT_BLOCK_CHANNEL, PARAMS_CHANNEL, SNAPSHOT_CHANNEL
+from . import messages as m
+
+DISCOVERY_TIME = 2.0
+CHUNK_TIMEOUT = 10.0
+CHUNK_FETCHERS = 4
+BACKFILL_BLOCKS = 32  # how many recent headers to backfill after restore
+
+
+@dataclass(frozen=True)
+class SyncConfig:
+    """Trust anchor for the state provider (reference config
+    statesync section: trust-height/trust-hash/trust-period)."""
+
+    trust_height: int
+    trust_hash: bytes
+    trust_period_ns: int = 7 * 24 * 3600 * 10**9
+    backfill_blocks: int = BACKFILL_BLOCKS
+
+
+class SyncAbortedError(RuntimeError):
+    pass
+
+
+class _Dispatcher(Provider):
+    """Request/response correlation for light-block fetches over p2p
+    (reference internal/statesync/dispatcher.go). Round-robins peers."""
+
+    def __init__(self, reactor: "StateSyncReactor"):
+        self.reactor = reactor
+        self._pending: dict[int, asyncio.Future] = {}
+        self._rr = 0
+
+    def chain_id(self) -> str:
+        return self.reactor.chain_id
+
+    async def light_block(self, height: int) -> LightBlock:
+        peers = list(self.reactor.peers)
+        if not peers:
+            raise LightBlockNotFoundError("no peers to fetch light blocks from")
+        last_err: Exception | None = None
+        for attempt in range(len(peers)):
+            peer = peers[(self._rr + attempt) % len(peers)]
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[height] = fut
+            self.reactor._send(
+                self.reactor.lb_ch, m.LightBlockRequest(height), to=peer
+            )
+            try:
+                lb = await asyncio.wait_for(fut, timeout=5.0)
+                if lb is not None:
+                    self._rr += 1
+                    return lb
+                last_err = LightBlockNotFoundError(f"peer {peer[:12]} lacks {height}")
+            except asyncio.TimeoutError:
+                last_err = LightBlockNotFoundError(f"timeout from {peer[:12]}")
+            finally:
+                self._pending.pop(height, None)
+        raise last_err or LightBlockNotFoundError(str(height))
+
+    def deliver(self, lb: LightBlock | None, height_hint: int | None = None) -> None:
+        height = lb.height if lb is not None else height_hint
+        fut = self._pending.get(height) if height is not None else None
+        if fut is None and lb is None and self._pending:
+            # a 'missing' reply carries no height; resolve the oldest
+            height, fut = next(iter(self._pending.items()))
+        if fut is not None and not fut.done():
+            fut.set_result(lb)
+
+    async def report_evidence(self, evidence) -> None:
+        pass  # evidence reactor handles gossip
+
+
+class StateSyncReactor(Service):
+    def __init__(
+        self,
+        chain_id: str,
+        app_conns,
+        state_store,
+        block_store,
+        snapshot_ch: Channel,
+        chunk_ch: Channel,
+        lb_ch: Channel,
+        params_ch: Channel,
+        peer_updates: asyncio.Queue,
+        *,
+        logger: logging.Logger | None = None,
+    ):
+        super().__init__("ss-reactor", logger)
+        self.chain_id = chain_id
+        self.app_conns = app_conns
+        self.state_store = state_store
+        self.block_store = block_store
+        self.snapshot_ch = snapshot_ch
+        self.chunk_ch = chunk_ch
+        self.lb_ch = lb_ch
+        self.params_ch = params_ch
+        self.peer_updates = peer_updates
+        self.peers: list[str] = []
+        self.dispatcher = _Dispatcher(self)
+        # discovery results: (height, format) -> (snapshot, set(providers))
+        self._snapshots: dict[tuple[int, int], tuple[m.SnapshotsResponse, set[str]]] = {}
+        self._chunk_futures: dict[tuple[int, int, int], asyncio.Future] = {}
+        self._params_futures: dict[int, asyncio.Future] = {}
+
+    async def on_start(self) -> None:
+        self.spawn(self._process_peer_updates(), name="ssr.peers")
+        self.spawn(self._process_snapshot_ch(), name="ssr.snap")
+        self.spawn(self._process_chunk_ch(), name="ssr.chunk")
+        self.spawn(self._process_lb_ch(), name="ssr.lb")
+        self.spawn(self._process_params_ch(), name="ssr.params")
+
+    def _send(self, ch: Channel, msg, *, to: str = "", broadcast: bool = False) -> None:
+        try:
+            ch.out_q.put_nowait(Envelope(ch.id, msg, to=to, broadcast=broadcast))
+        except asyncio.QueueFull:
+            self.logger.warning("statesync outbound full on %s", ch.name)
+
+    # -- peer + serving side --------------------------------------------
+
+    async def _process_peer_updates(self) -> None:
+        while True:
+            upd = await self.peer_updates.get()
+            if upd.status == PeerStatus.UP:
+                if upd.node_id not in self.peers:
+                    self.peers.append(upd.node_id)
+            else:
+                if upd.node_id in self.peers:
+                    self.peers.remove(upd.node_id)
+
+    async def _process_snapshot_ch(self) -> None:
+        async for env in self.snapshot_ch:
+            msg = env.message
+            if isinstance(msg, m.SnapshotsRequest):
+                res = await self.app_conns.snapshot.list_snapshots()
+                for snap in res.snapshots[-4:]:
+                    self._send(
+                        self.snapshot_ch,
+                        m.SnapshotsResponse(
+                            snap.height, snap.format, snap.chunks, snap.hash, snap.metadata
+                        ),
+                        to=env.from_,
+                    )
+            elif isinstance(msg, m.SnapshotsResponse):
+                key = (msg.height, msg.format)
+                snap, providers = self._snapshots.get(key, (msg, set()))
+                providers.add(env.from_)
+                self._snapshots[key] = (snap, providers)
+
+    async def _process_chunk_ch(self) -> None:
+        async for env in self.chunk_ch:
+            msg = env.message
+            if isinstance(msg, m.ChunkRequest):
+                res = await self.app_conns.snapshot.load_snapshot_chunk(
+                    abci.RequestLoadSnapshotChunk(msg.height, msg.format, msg.index)
+                )
+                self._send(
+                    self.chunk_ch,
+                    m.ChunkResponse(
+                        msg.height, msg.format, msg.index, res.chunk, not res.chunk
+                    ),
+                    to=env.from_,
+                )
+            elif isinstance(msg, m.ChunkResponse):
+                fut = self._chunk_futures.get((msg.height, msg.format, msg.index))
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+
+    async def _process_lb_ch(self) -> None:
+        async for env in self.lb_ch:
+            msg = env.message
+            if isinstance(msg, m.LightBlockRequest):
+                lb = self._local_light_block(msg.height)
+                self._send(self.lb_ch, m.LightBlockResponse(lb), to=env.from_)
+            elif isinstance(msg, m.LightBlockResponse):
+                self.dispatcher.deliver(msg.light_block)
+
+    def _local_light_block(self, height: int) -> LightBlock | None:
+        if height == 0:
+            height = self.block_store.height()
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        if commit is None:
+            commit = self.block_store.load_seen_commit(height)
+        vals = self.state_store.load_validators(height)
+        if meta is None or commit is None or vals is None:
+            return None
+        return LightBlock(SignedHeader(meta.header, commit), vals)
+
+    async def _process_params_ch(self) -> None:
+        async for env in self.params_ch:
+            msg = env.message
+            if isinstance(msg, m.ParamsRequest):
+                params = self.state_store.load_consensus_params(msg.height)
+                self._send(
+                    self.params_ch, m.ParamsResponse(msg.height, params), to=env.from_
+                )
+            elif isinstance(msg, m.ParamsResponse):
+                fut = self._params_futures.get(msg.height)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg.params)
+
+    # -- sync side -------------------------------------------------------
+
+    async def sync(self, config: SyncConfig) -> State:
+        """Reference Sync reactor.go:269 + SyncAny syncer.go:167."""
+        light = LightClient(
+            self.chain_id,
+            TrustOptions(config.trust_period_ns, config.trust_height, config.trust_hash),
+            self.dispatcher,
+            store=TrustedStore(),
+        )
+        # discovery
+        deadline = asyncio.get_running_loop().time() + 30
+        while not self._snapshots:
+            if asyncio.get_running_loop().time() > deadline:
+                raise SyncAbortedError("no snapshots discovered")
+            self._send(self.snapshot_ch, m.SnapshotsRequest(), broadcast=True)
+            await asyncio.sleep(DISCOVERY_TIME)
+
+        tried: set[tuple[int, int]] = set()
+        while True:
+            candidates = sorted(
+                (k for k in self._snapshots if k not in tried),
+                key=lambda k: (-k[0], k[1]),
+            )
+            if not candidates:
+                raise SyncAbortedError("all discovered snapshots failed")
+            key = candidates[0]
+            snap, providers = self._snapshots[key]
+            tried.add(key)
+            try:
+                return await self._restore(snap, list(providers), light, config)
+            except SyncAbortedError:
+                raise
+            except Exception as e:
+                self.logger.info("snapshot %s failed: %r; trying next", key, e)
+
+    async def _restore(
+        self,
+        snap: m.SnapshotsResponse,
+        providers: list[str],
+        light: LightClient,
+        config: SyncConfig,
+    ) -> State:
+        h = snap.height
+        # verify headers at h, h+1, h+2 (valsets + app hash pins)
+        lb_h = await light.verify_light_block_at_height(h)
+        lb_h1 = await light.verify_light_block_at_height(h + 1)
+        lb_h2 = await light.verify_light_block_at_height(h + 2)
+        app_hash = lb_h1.header.app_hash
+
+        # offer to the app (reference offerSnapshot syncer.go:373)
+        res = await self.app_conns.snapshot.offer_snapshot(
+            abci.RequestOfferSnapshot(
+                abci.Snapshot(snap.height, snap.format, snap.chunks, snap.hash, snap.metadata),
+                app_hash,
+            )
+        )
+        if res.result == abci.OfferSnapshotResult.ABORT:
+            raise SyncAbortedError("app aborted snapshot restore")
+        if res.result != abci.OfferSnapshotResult.ACCEPT:
+            raise RuntimeError(f"snapshot rejected: {res.result!r}")
+
+        # fetch + apply chunks (reference fetchChunks :470 / applyChunks :409)
+        chunks: dict[int, bytes] = {}
+        sem = asyncio.Semaphore(CHUNK_FETCHERS)
+
+        async def fetch(idx: int) -> None:
+            async with sem:
+                for attempt, peer in enumerate(providers * 3):
+                    fut: asyncio.Future = asyncio.get_running_loop().create_future()
+                    self._chunk_futures[(snap.height, snap.format, idx)] = fut
+                    self._send(
+                        self.chunk_ch,
+                        m.ChunkRequest(snap.height, snap.format, idx),
+                        to=peer,
+                    )
+                    try:
+                        res = await asyncio.wait_for(fut, CHUNK_TIMEOUT)
+                        if not res.missing:
+                            chunks[idx] = res.chunk
+                            return
+                    except asyncio.TimeoutError:
+                        continue
+                    finally:
+                        self._chunk_futures.pop((snap.height, snap.format, idx), None)
+                raise RuntimeError(f"chunk {idx} unavailable")
+
+        await asyncio.gather(*(fetch(i) for i in range(snap.chunks)))
+        for idx in range(snap.chunks):
+            res = await self.app_conns.snapshot.apply_snapshot_chunk(
+                abci.RequestApplySnapshotChunk(idx, chunks[idx])
+            )
+            if res.result == abci.ApplySnapshotChunkResult.ABORT:
+                raise SyncAbortedError("app aborted during chunk apply")
+            if res.result not in (
+                abci.ApplySnapshotChunkResult.ACCEPT,
+                abci.ApplySnapshotChunkResult.RETRY,
+            ):
+                raise RuntimeError(f"chunk {idx} rejected: {res.result!r}")
+
+        # verify the app actually restored the right state (syncer.go:556)
+        info = await self.app_conns.query.info(abci.RequestInfo())
+        if info.last_block_app_hash != app_hash:
+            raise RuntimeError(
+                f"restored app hash {info.last_block_app_hash.hex()} != "
+                f"verified {app_hash.hex()}"
+            )
+        if info.last_block_height != h:
+            raise RuntimeError(
+                f"restored app height {info.last_block_height} != snapshot {h}"
+            )
+
+        # consensus params for h+1 (0x63, reference paramsCh)
+        params = await self._fetch_params(h + 1, providers)
+
+        # build + persist State (reference stateprovider State())
+        state = State(
+            chain_id=self.chain_id,
+            initial_height=1,
+            last_block_height=h,
+            last_block_id=lb_h1.header.last_block_id,
+            last_block_time_ns=lb_h.header.time_ns,
+            validators=lb_h1.validators,
+            next_validators=lb_h2.validators,
+            last_validators=lb_h.validators,
+            last_height_validators_changed=0,
+            consensus_params=params,
+            last_height_consensus_params_changed=0,
+            last_results_hash=lb_h1.header.last_results_hash,
+            app_hash=app_hash,
+        )
+        self.state_store.bootstrap(state)
+        self.block_store.bootstrap(h)
+        self.block_store.save_signed_header(
+            lb_h.header, lb_h.signed_header.commit,
+            lb_h.signed_header.commit.block_id,
+        )
+        self.block_store.save_seen_commit(h, lb_h.signed_header.commit)
+
+        await self._backfill(lb_h, config.backfill_blocks)
+        self.logger.info("state sync complete at height %d", h)
+        return state
+
+    async def _fetch_params(self, height: int, providers: list[str]):
+        from ..types.params import ConsensusParams
+
+        for peer in providers:
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._params_futures[height] = fut
+            self._send(self.params_ch, m.ParamsRequest(height), to=peer)
+            try:
+                params = await asyncio.wait_for(fut, 5.0)
+                if params is not None:
+                    return params
+            except asyncio.TimeoutError:
+                continue
+            finally:
+                self._params_futures.pop(height, None)
+        self.logger.warning("no peer served consensus params; using defaults")
+        return ConsensusParams()
+
+    async def _backfill(self, from_lb: LightBlock, n: int) -> None:
+        """Reverse-fetch recent headers, verified by hash-chain linkage
+        (reference Backfill reactor.go:348,481-486 — NOT signatures)."""
+        cur = from_lb
+        for _ in range(n):
+            prev_height = cur.height - 1
+            if prev_height < 1:
+                break
+            try:
+                prev = await self.dispatcher.light_block(prev_height)
+            except LightBlockNotFoundError:
+                break
+            if prev.header.hash() != cur.header.last_block_id.hash:
+                self.logger.warning("backfill hash chain broken at %d", prev_height)
+                break
+            self.block_store.save_signed_header(
+                prev.header,
+                prev.signed_header.commit,
+                prev.signed_header.commit.block_id,
+            )
+            self.state_store.save_validators(prev_height, prev.validators)
+            cur = prev
+        self.logger.info("backfilled headers down to height %d", cur.height)

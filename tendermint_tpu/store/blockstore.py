@@ -124,6 +124,29 @@ class BlockStore:
     def save_seen_commit(self, height: int, commit: Commit) -> None:
         self.db.set(_hkey(_SEEN, height), commit.encode())
 
+    def bootstrap(self, height: int) -> None:
+        """State-sync bootstrap: position the store at `height` without
+        any blocks, so block-sync/consensus continue from height+1
+        (reference store.go SaveSeenCommit + state bootstrap path)."""
+        with self._lock:
+            if self._height != 0:
+                raise ValueError("bootstrap on a non-empty block store")
+            self._base = height + 1
+            self._height = height
+            self._save_state([])
+
+    def save_signed_header(self, header, commit: Commit, block_id: BlockID) -> None:
+        """Store a backfilled header+commit without block data (statesync
+        Backfill, reference reactor.go:348): enough for evidence
+        verification and light-block serving, below the store base."""
+        meta = BlockMeta(block_id, 0, header, 0)
+        sets = [
+            (_hkey(_META, header.height), meta.encode()),
+            (_HASH + header.hash(), header.height.to_bytes(8, "big")),
+            (_hkey(_COMMIT, header.height), commit.encode()),
+        ]
+        self.db.write_batch(sets)
+
     def load_block_meta(self, height: int) -> BlockMeta | None:
         raw = self.db.get(_hkey(_META, height))
         return BlockMeta.decode(raw) if raw is not None else None

@@ -1,0 +1,193 @@
+"""Light client tests (modeled on reference light/verifier_test.go and
+light/client_test.go: sequential, skipping with validator rotation,
+backwards, expired trust, divergence detection)."""
+
+import asyncio
+from fractions import Fraction
+
+import pytest
+
+from tendermint_tpu.consensus.harness import LocalNetwork
+from tendermint_tpu.light.client import (
+    Divergence,
+    LightClient,
+    TrustOptions,
+    TrustedStore,
+)
+from tendermint_tpu.light.provider import BlockStoreProvider, LightBlockNotFoundError
+from tendermint_tpu.light.types import LightBlock, SignedHeader
+from tendermint_tpu.light import verifier
+from tendermint_tpu.light.verifier import VerificationError
+from tendermint_tpu.testing import make_commit
+from tendermint_tpu.types.block import BlockID
+
+
+HOUR_NS = 3600 * 10**9
+LONG_NS = 10 * 365 * 24 * HOUR_NS  # block 1 carries the (old) genesis time
+
+
+async def run_chain(n_vals=3, heights=4):
+    """Produce a real chain and return (net, provider for node 0)."""
+    net = LocalNetwork(n_vals)
+    await net.start()
+    await net.wait_for_height(heights, timeout=60)
+    await net.stop()
+    node = net.nodes[0]
+    return net, BlockStoreProvider(net.genesis.chain_id, node.block_store, node.state_store)
+
+
+class TestVerifier:
+    @pytest.mark.asyncio
+    async def test_adjacent_and_nonadjacent(self):
+        net, provider = await run_chain(heights=5)
+        chain_id = net.genesis.chain_id
+        lb1 = await provider.light_block(1)
+        lb2 = await provider.light_block(2)
+        lb4 = await provider.light_block(4)
+        now = lb4.header.time_ns + 1_000_000_000
+        verifier.verify_adjacent(chain_id, lb1, lb2, LONG_NS, now)
+        # skipping 1 -> 4 (same validator set: 100% overlap)
+        verifier.verify_non_adjacent(chain_id, lb1, lb4, LONG_NS, now)
+        # reversed heights rejected
+        with pytest.raises(VerificationError):
+            verifier.verify_adjacent(chain_id, lb2, lb1, LONG_NS, now)
+
+    @pytest.mark.asyncio
+    async def test_expired_trust_rejected(self):
+        net, provider = await run_chain(heights=3)
+        chain_id = net.genesis.chain_id
+        lb1 = await provider.light_block(1)
+        lb2 = await provider.light_block(2)
+        long_after = lb1.header.time_ns + 10 * HOUR_NS
+        with pytest.raises(VerificationError):
+            verifier.verify_adjacent(chain_id, lb1, lb2, HOUR_NS, long_after)
+
+    @pytest.mark.asyncio
+    async def test_tampered_commit_rejected(self):
+        net, provider = await run_chain(heights=3)
+        chain_id = net.genesis.chain_id
+        lb1 = await provider.light_block(1)
+        lb2 = await provider.light_block(2)
+        # graft a commit whose signatures are for a different block id
+        from tendermint_tpu.testing import make_block_id
+
+        fake_bid = make_block_id(b"attack")
+        bad_commit = make_commit(
+            chain_id, 2, lb2.signed_header.commit.round, fake_bid,
+            lb2.validators,
+            {k.pub_key().address(): k for k in net.keys},
+        )
+        bad_lb = LightBlock(SignedHeader(lb2.header, bad_commit), lb2.validators)
+        now = lb2.header.time_ns + 10**9
+        with pytest.raises((VerificationError, ValueError)):
+            verifier.verify_adjacent(chain_id, lb1, bad_lb, LONG_NS, now)
+
+
+class TestLightClient:
+    @pytest.mark.asyncio
+    async def test_initialize_and_verify_forward(self):
+        net, provider = await run_chain(heights=5)
+        chain_id = net.genesis.chain_id
+        lb1 = await provider.light_block(1)
+        client = LightClient(
+            chain_id,
+            TrustOptions(LONG_NS, 1, lb1.header.hash()),
+            provider,
+        )
+        tip = await provider.light_block(0)
+        got = await client.verify_light_block_at_height(tip.height)
+        assert got.header.hash() == tip.header.hash()
+        # intermediate headers cached in the trusted store on bisection path
+        assert client.store.latest().height == tip.height
+
+    @pytest.mark.asyncio
+    async def test_initialize_rejects_wrong_hash(self):
+        net, provider = await run_chain(heights=3)
+        client = LightClient(
+            net.genesis.chain_id,
+            TrustOptions(LONG_NS, 1, b"\x00" * 32),
+            provider,
+        )
+        with pytest.raises(VerificationError):
+            await client.initialize()
+
+    @pytest.mark.asyncio
+    async def test_backwards_verification(self):
+        net, provider = await run_chain(heights=5)
+        chain_id = net.genesis.chain_id
+        lb4 = await provider.light_block(4)
+        client = LightClient(
+            chain_id,
+            TrustOptions(LONG_NS, 4, lb4.header.hash()),
+            provider,
+        )
+        await client.initialize()
+        lb2 = await client.verify_light_block_at_height(2)
+        assert lb2.height == 2
+        assert lb2.header.hash() == (await provider.light_block(2)).header.hash()
+
+    @pytest.mark.asyncio
+    async def test_witness_divergence_detected(self):
+        net, provider = await run_chain(heights=4)
+        chain_id = net.genesis.chain_id
+        lb1 = await provider.light_block(1)
+
+        class ForkedProvider(BlockStoreProvider):
+            """Witness serving a validly-signed CONFLICTING header."""
+
+            async def light_block(self, height):
+                lb = await super().light_block(height)
+                if lb.height < 3:
+                    return lb
+                keys = {k.pub_key().address(): k for k in net.keys}
+                # forge a different header (evil app hash) and sign it
+                from dataclasses import replace
+
+                evil = replace(lb.header, app_hash=b"\xde\xad" * 16)
+                bid = BlockID(evil.hash(), lb.signed_header.commit.block_id.part_set_header)
+                commit = make_commit(
+                    chain_id, lb.height, 0, bid, lb.validators, keys
+                )
+                return LightBlock(SignedHeader(evil, commit), lb.validators)
+
+        witness = ForkedProvider(
+            chain_id, net.nodes[0].block_store, net.nodes[0].state_store
+        )
+        client = LightClient(
+            chain_id,
+            TrustOptions(LONG_NS, 1, lb1.header.hash()),
+            provider,
+            witnesses=[witness],
+        )
+        with pytest.raises(Divergence):
+            await client.verify_light_block_at_height(3)
+
+    @pytest.mark.asyncio
+    async def test_bad_witness_dropped_not_fatal(self):
+        net, provider = await run_chain(heights=3)
+        chain_id = net.genesis.chain_id
+        lb1 = await provider.light_block(1)
+
+        class GarbageProvider(BlockStoreProvider):
+            async def light_block(self, height):
+                lb = await super().light_block(height)
+                from dataclasses import replace
+
+                evil = replace(lb.header, app_hash=b"\xbb" * 32)
+                # unsigned garbage: commit doesn't match the forged header
+                return LightBlock(
+                    SignedHeader(evil, lb.signed_header.commit), lb.validators
+                )
+
+        witness = GarbageProvider(
+            chain_id, net.nodes[0].block_store, net.nodes[0].state_store
+        )
+        client = LightClient(
+            chain_id,
+            TrustOptions(LONG_NS, 1, lb1.header.hash()),
+            provider,
+            witnesses=[witness],
+        )
+        got = await client.verify_light_block_at_height(2)
+        assert got.height == 2
+        assert client.witnesses == []  # garbage witness removed
