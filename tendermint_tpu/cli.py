@@ -1,0 +1,390 @@
+"""Command-line interface (reference cmd/tendermint/commands).
+
+  init       — write config.toml, genesis.json, node + validator keys
+  start      — run a full node (builtin kvstore app) until interrupted
+  testnet    — generate N validator homes with a shared genesis
+  show-node-id / show-validator
+  gen-node-key / gen-validator
+  reset      — wipe data, keep keys/config (unsafe-reset-all)
+  light      — verify a height against a running node over RPC
+  inspect    — read-only report over a stopped node's data dirs
+  version
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+
+from . import version as _version_mod
+from .config import Config, config_from_toml, config_to_toml
+from .crypto import ed25519
+from .p2p.types import NodeAddress, node_id_from_pubkey
+from .privval import FilePV
+from .types.genesis import GenesisDoc, GenesisValidator
+
+
+def _home(args) -> str:
+    return os.path.expanduser(args.home)
+
+
+def _paths(home: str) -> dict:
+    return {
+        "config": os.path.join(home, "config"),
+        "data": os.path.join(home, "data"),
+        "config_toml": os.path.join(home, "config", "config.toml"),
+        "genesis": os.path.join(home, "config", "genesis.json"),
+        "node_key": os.path.join(home, "config", "node_key.json"),
+        "pv_key": os.path.join(home, "config", "priv_validator_key.json"),
+        "pv_state": os.path.join(home, "data", "priv_validator_state.json"),
+    }
+
+
+def _load_or_gen_node_key(path: str) -> ed25519.Ed25519PrivKey:
+    if os.path.exists(path):
+        with open(path) as f:
+            return ed25519.Ed25519PrivKey(bytes.fromhex(json.load(f)["priv_key"])[:32])
+    key = ed25519.Ed25519PrivKey.generate()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(
+            {
+                "id": node_id_from_pubkey(key.pub_key()),
+                "priv_key": key.bytes().hex(),
+            },
+            f,
+            indent=2,
+        )
+    return key
+
+
+def cmd_init(args) -> int:
+    """Reference commands/init.go."""
+    home = _home(args)
+    p = _paths(home)
+    os.makedirs(p["config"], exist_ok=True)
+    os.makedirs(p["data"], exist_ok=True)
+    if not os.path.exists(p["config_toml"]):
+        cfg = Config(moniker=args.moniker or "node")
+        with open(p["config_toml"], "w") as f:
+            f.write(config_to_toml(cfg))
+    node_key = _load_or_gen_node_key(p["node_key"])
+    pv = FilePV.load_or_generate(p["pv_key"], p["pv_state"])
+    if not os.path.exists(p["genesis"]):
+        import time
+
+        doc = GenesisDoc(
+            chain_id=args.chain_id or f"test-chain-{os.urandom(3).hex()}",
+            genesis_time_ns=time.time_ns(),
+            validators=[GenesisValidator(pv.get_pub_key(), 10, "validator")]
+            if args.mode == "validator"
+            else [],
+        )
+        with open(p["genesis"], "w") as f:
+            f.write(doc.to_json())
+    print(f"initialized {args.mode} node in {home}")
+    print(f"node id: {node_id_from_pubkey(node_key.pub_key())}")
+    return 0
+
+
+def _build_node(home: str):
+    from .abci.kvstore import KVStoreApp
+    from .node import Node, NodeConfig
+    from .p2p.tcp import TCPTransport
+    from .statesync.reactor import SyncConfig
+    from .store.db import SQLiteDB
+
+    p = _paths(home)
+    with open(p["config_toml"]) as f:
+        cfg = config_from_toml(f.read())
+    with open(p["genesis"]) as f:
+        genesis = GenesisDoc.from_json(f.read())
+    node_key = _load_or_gen_node_key(p["node_key"])
+    pv = (
+        FilePV.load_or_generate(p["pv_key"], p["pv_state"])
+        if os.path.exists(p["pv_key"]) or True
+        else None
+    )
+    if cfg.proxy_app == "kvstore":
+        app = KVStoreApp(SQLiteDB(os.path.join(p["data"], "app.db")))
+    else:
+        raise SystemExit(f"unknown proxy app {cfg.proxy_app!r} (builtin: kvstore)")
+    state_sync = None
+    if cfg.statesync.enable and cfg.statesync.trust_hash:
+        state_sync = SyncConfig(
+            trust_height=cfg.statesync.trust_height,
+            trust_hash=bytes.fromhex(cfg.statesync.trust_hash),
+            trust_period_ns=cfg.statesync.trust_period_ns,
+        )
+    node_config = NodeConfig(
+        consensus=cfg.consensus,
+        mempool=cfg.mempool,
+        block_sync=cfg.blocksync.enable,
+        state_sync=state_sync,
+        moniker=cfg.moniker,
+        wal_dir=os.path.join(p["data"], "cs.wal"),
+        rpc_laddr=cfg.rpc.laddr if cfg.rpc.enable else "",
+    )
+    transport = TCPTransport()
+    node = Node(
+        node_config,
+        genesis,
+        app,
+        node_key,
+        [transport],
+        priv_validator=pv,
+        block_db=SQLiteDB(os.path.join(p["data"], "blockstore.db")),
+        state_db=SQLiteDB(os.path.join(p["data"], "state.db")),
+        evidence_db=SQLiteDB(os.path.join(p["data"], "evidence.db")),
+    )
+    return node, cfg, transport
+
+
+async def _run_node(home: str) -> None:
+    node, cfg, transport = _build_node(home)
+    await transport.listen(cfg.p2p.laddr)
+    await node.start()
+    for peer in filter(None, cfg.p2p.persistent_peers.split(",")):
+        node.peer_manager.add_address(NodeAddress.parse(peer.strip()), persistent=True)
+    print(f"node {node.node_id} running; p2p on {transport.endpoint()}", flush=True)
+    stop = asyncio.Event()
+    import signal
+
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+    print("shutting down…", flush=True)
+    await node.stop()
+
+
+def cmd_start(args) -> int:
+    asyncio.run(_run_node(_home(args)))
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Generate N validator homes (reference commands/testnet.go)."""
+    import time
+
+    base = os.path.expanduser(args.output)
+    n = args.validators
+    pvs, node_keys = [], []
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        p = _paths(home)
+        os.makedirs(p["config"], exist_ok=True)
+        os.makedirs(p["data"], exist_ok=True)
+        pvs.append(FilePV.load_or_generate(p["pv_key"], p["pv_state"]))
+        node_keys.append(_load_or_gen_node_key(p["node_key"]))
+    doc = GenesisDoc(
+        chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
+        genesis_time_ns=time.time_ns(),
+        validators=[
+            GenesisValidator(pv.get_pub_key(), 10, f"val{i}")
+            for i, pv in enumerate(pvs)
+        ],
+    )
+    peers = ",".join(
+        f"tcp://{node_id_from_pubkey(nk.pub_key())}@127.0.0.1:{args.base_port + 2 * i}"
+        for i, nk in enumerate(node_keys)
+    )
+    for i in range(n):
+        home = os.path.join(base, f"node{i}")
+        p = _paths(home)
+        cfg = Config(moniker=f"node{i}")
+        cfg.p2p.laddr = f"127.0.0.1:{args.base_port + 2 * i}"
+        cfg.rpc.laddr = f"127.0.0.1:{args.base_port + 2 * i + 1}"
+        cfg.p2p.persistent_peers = peers
+        with open(p["config_toml"], "w") as f:
+            f.write(config_to_toml(cfg))
+        with open(p["genesis"], "w") as f:
+            f.write(doc.to_json())
+    print(f"generated {n}-validator testnet in {base}")
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    key = _load_or_gen_node_key(_paths(_home(args))["node_key"])
+    print(node_id_from_pubkey(key.pub_key()))
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    p = _paths(_home(args))
+    pv = FilePV.load(p["pv_key"], p["pv_state"])
+    pub = pv.get_pub_key()
+    print(json.dumps({"type": pub.TYPE, "value": pub.bytes().hex()}))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    key = ed25519.Ed25519PrivKey.generate()
+    print(
+        json.dumps(
+            {"id": node_id_from_pubkey(key.pub_key()), "priv_key": key.bytes().hex()}
+        )
+    )
+    return 0
+
+
+def cmd_gen_validator(args) -> int:
+    key = ed25519.Ed25519PrivKey.generate()
+    print(
+        json.dumps(
+            {
+                "address": key.pub_key().address().hex(),
+                "pub_key": key.pub_key().bytes().hex(),
+                "priv_key": key.bytes().hex(),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_reset(args) -> int:
+    """Wipe chain data, keep config + keys; reset sign-state (reference
+    unsafe-reset-all)."""
+    home = _home(args)
+    p = _paths(home)
+    for name in ("blockstore.db", "state.db", "evidence.db", "app.db", "cs.wal"):
+        path = os.path.join(p["data"], name)
+        if os.path.isdir(path):
+            shutil.rmtree(path)
+        elif os.path.exists(path):
+            os.remove(path)
+    if os.path.exists(p["pv_state"]):
+        with open(p["pv_state"], "w") as f:
+            json.dump(
+                {"height": 0, "round": 0, "step": 0, "sign_bytes": "", "signature": ""},
+                f,
+            )
+    print(f"reset data in {home}")
+    return 0
+
+
+def cmd_light(args) -> int:
+    """Verify a height against a node over RPC (reference tendermint
+    light, condensed: no proxy server yet)."""
+
+    async def run() -> int:
+        from .light.client import LightClient, TrustOptions
+        from .rpc.client import HTTPClient, HTTPProvider
+
+        client = HTTPClient(args.address)
+        try:
+            chain_id = (await client.status())["node_info"]["network"]
+            provider = HTTPProvider(chain_id, client)
+            anchor = await provider.light_block(args.trust_height)
+            trust_hash = (
+                bytes.fromhex(args.trust_hash)
+                if args.trust_hash
+                else anchor.header.hash()
+            )
+            lc = LightClient(
+                chain_id,
+                TrustOptions(args.trust_period * 10**9, args.trust_height, trust_hash),
+                provider,
+            )
+            lb = await lc.verify_light_block_at_height(args.height)
+            print(
+                json.dumps(
+                    {
+                        "height": lb.height,
+                        "hash": lb.header.hash().hex().upper(),
+                        "app_hash": lb.header.app_hash.hex().upper(),
+                    }
+                )
+            )
+            return 0
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
+
+
+def cmd_inspect(args) -> int:
+    """Read-only report over a stopped node's stores (reference
+    internal/inspect)."""
+    from .state.store import StateStore
+    from .store.blockstore import BlockStore
+    from .store.db import SQLiteDB
+
+    p = _paths(_home(args))
+    bs = BlockStore(SQLiteDB(os.path.join(p["data"], "blockstore.db")))
+    ss = StateStore(SQLiteDB(os.path.join(p["data"], "state.db")))
+    state = ss.load()
+    report = {
+        "block_store": {"base": bs.base(), "height": bs.height()},
+        "state": {
+            "chain_id": state.chain_id if state else None,
+            "last_block_height": state.last_block_height if state else 0,
+            "app_hash": state.app_hash.hex() if state else "",
+            "validators": len(state.validators) if state and state.validators else 0,
+        },
+    }
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+def cmd_version(args) -> int:
+    print(_version_mod.VERSION)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tendermint-tpu", description="TPU-native BFT consensus node"
+    )
+    parser.add_argument("--home", default="~/.tendermint_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_init = sub.add_parser("init", help="initialize a node home")
+    p_init.add_argument("mode", nargs="?", default="validator", choices=["validator", "full"])
+    p_init.add_argument("--chain-id", default="")
+    p_init.add_argument("--moniker", default="")
+    p_init.set_defaults(fn=cmd_init)
+
+    p_start = sub.add_parser("start", help="run the node")
+    p_start.set_defaults(fn=cmd_start)
+
+    p_testnet = sub.add_parser("testnet", help="generate a local testnet")
+    p_testnet.add_argument("--validators", "-v", type=int, default=4)
+    p_testnet.add_argument("--output", "-o", default="./testnet")
+    p_testnet.add_argument("--chain-id", default="")
+    p_testnet.add_argument("--base-port", type=int, default=26656)
+    p_testnet.set_defaults(fn=cmd_testnet)
+
+    sub.add_parser("show-node-id").set_defaults(fn=cmd_show_node_id)
+    sub.add_parser("show-validator").set_defaults(fn=cmd_show_validator)
+    sub.add_parser("gen-node-key").set_defaults(fn=cmd_gen_node_key)
+    sub.add_parser("gen-validator").set_defaults(fn=cmd_gen_validator)
+    sub.add_parser("reset", help="wipe chain data (unsafe-reset-all)").set_defaults(
+        fn=cmd_reset
+    )
+    sub.add_parser("inspect", help="report over a stopped node").set_defaults(
+        fn=cmd_inspect
+    )
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    p_light = sub.add_parser("light", help="light-verify a height over RPC")
+    p_light.add_argument("--address", default="http://127.0.0.1:26657")
+    p_light.add_argument("--height", type=int, default=0)
+    p_light.add_argument("--trust-height", type=int, default=1)
+    p_light.add_argument("--trust-hash", default="")
+    p_light.add_argument("--trust-period", type=int, default=7 * 24 * 3600)
+    p_light.set_defaults(fn=cmd_light)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

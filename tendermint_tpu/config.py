@@ -61,3 +61,105 @@ class EvidenceConfig:
     pool sizing."""
 
     max_pending: int = 1000
+
+
+@dataclass
+class P2PConfig:
+    """Reference config/config.go P2PConfig."""
+
+    laddr: str = "0.0.0.0:26656"
+    persistent_peers: str = ""  # comma-separated tcp://id@host:port
+    max_connections: int = 16
+
+
+@dataclass
+class RPCConfig:
+    """Reference config/config.go RPCConfig."""
+
+    laddr: str = "127.0.0.1:26657"
+    enable: bool = True
+
+
+@dataclass
+class StateSyncConfig:
+    """Reference config statesync section."""
+
+    enable: bool = False
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_ns: int = 7 * 24 * 3600 * 10**9
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+
+
+@dataclass
+class Config:
+    """The full node config tree (reference config/config.go:70),
+    TOML-serialized in <home>/config/config.toml."""
+
+    moniker: str = "node"
+    proxy_app: str = "kvstore"  # builtin app name (socket ABCI later)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+
+
+def _section_to_toml(name: str, obj) -> str:
+    lines = [f"[{name}]"]
+    for k, v in obj.__dict__.items():
+        if isinstance(v, bool):
+            lines.append(f"{k} = {'true' if v else 'false'}")
+        elif isinstance(v, (int, float)):
+            lines.append(f"{k} = {v}")
+        else:
+            lines.append(f'{k} = "{v}"')
+    return "\n".join(lines)
+
+
+def config_to_toml(cfg: Config) -> str:
+    """Serialize (reference config/toml.go template)."""
+    parts = [
+        f'moniker = "{cfg.moniker}"',
+        f'proxy_app = "{cfg.proxy_app}"',
+        "",
+        _section_to_toml("consensus", cfg.consensus),
+        "",
+        _section_to_toml("mempool", cfg.mempool),
+        "",
+        _section_to_toml("p2p", cfg.p2p),
+        "",
+        _section_to_toml("rpc", cfg.rpc),
+        "",
+        _section_to_toml("statesync", cfg.statesync),
+        "",
+        _section_to_toml("blocksync", cfg.blocksync),
+        "",
+    ]
+    return "\n".join(parts)
+
+
+def config_from_toml(text: str) -> Config:
+    import tomllib
+
+    data = tomllib.loads(text)
+    cfg = Config()
+    cfg.moniker = data.get("moniker", cfg.moniker)
+    cfg.proxy_app = data.get("proxy_app", cfg.proxy_app)
+    for section, obj in (
+        ("consensus", cfg.consensus),
+        ("mempool", cfg.mempool),
+        ("p2p", cfg.p2p),
+        ("rpc", cfg.rpc),
+        ("statesync", cfg.statesync),
+        ("blocksync", cfg.blocksync),
+    ):
+        for k, v in data.get(section, {}).items():
+            if hasattr(obj, k):
+                setattr(obj, k, v)
+    return cfg

@@ -72,6 +72,9 @@ class NodeConfig:
     state_sync: SyncConfig | None = None
     moniker: str = ""
     wal_dir: str = ""
+    # RPC listen address, e.g. "127.0.0.1:26657"; empty disables RPC
+    rpc_laddr: str = ""
+    tx_index: bool = True
 
 
 class Node(Service):
@@ -124,6 +127,9 @@ class Node(Service):
         self.evidence_reactor: EvidenceReactor | None = None
         self.blocksync_reactor: BlockSyncReactor | None = None
         self.statesync_reactor: StateSyncReactor | None = None
+        self.indexer = None
+        self.sink = None
+        self.rpc_server = None
         self.state = None
 
     # -- channels --------------------------------------------------------
@@ -251,10 +257,39 @@ class Node(Service):
             self.peer_manager.subscribe(),
         )
 
+        if self.config.tx_index:
+            from .state.indexer import IndexerService, KVSink
+
+            self.sink = KVSink(MemDB())
+            self.indexer = IndexerService(self.sink, self.event_bus)
+            await self.indexer.start()
+
         await self.router.start()
         await self.mempool_reactor.start()
         await self.evidence_reactor.start()
         await self.statesync_reactor.start()
+
+        if self.config.rpc_laddr:
+            from .rpc.core import Environment
+            from .rpc.server import RPCServer
+
+            env = Environment(
+                chain_id=self.genesis.chain_id,
+                genesis_doc=self.genesis,
+                state_store=self.state_store,
+                block_store=self.block_store,
+                mempool=self.mempool,
+                evidence_pool=self.evidence_pool,
+                consensus=self.consensus,
+                app_conns=self.app_conns,
+                event_bus=self.event_bus,
+                sink=self.sink,
+                peer_manager=self.peer_manager,
+                node_info=self.node_info,
+            )
+            self.rpc_server = RPCServer(env)
+            host, _, port = self.config.rpc_laddr.rpartition(":")
+            await self.rpc_server.start(host or "127.0.0.1", int(port or 0))
         if (
             self.config.state_sync is not None
             and self.state.last_block_height == 0
@@ -331,6 +366,11 @@ class Node(Service):
         await self.consensus.start()
 
     async def on_stop(self) -> None:
+        if self.rpc_server is not None:
+            try:
+                await self.rpc_server.stop()
+            except Exception:
+                pass
         for svc in (
             self.cs_reactor,
             self.consensus,
@@ -338,6 +378,7 @@ class Node(Service):
             self.statesync_reactor,
             self.evidence_reactor,
             self.mempool_reactor,
+            self.indexer,
             self.router,
         ):
             if svc is not None:

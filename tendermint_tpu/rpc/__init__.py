@@ -1,0 +1,3 @@
+"""JSON-RPC layer (reference internal/rpc/core + rpc/jsonrpc): ~30
+routes over HTTP POST (JSON-RPC 2.0), GET (URI params), and websocket
+event subscriptions."""

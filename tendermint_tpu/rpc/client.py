@@ -1,0 +1,191 @@
+"""RPC clients (reference rpc/client): `HTTPClient` speaks JSON-RPC to a
+node's RPC server; `websocket_events` yields subscription events.
+`HTTPProvider` adapts the client into a light-client Provider (reference
+light/provider/http)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, AsyncIterator
+
+import aiohttp
+
+from ..light.provider import LightBlockNotFoundError, Provider, ProviderError
+from ..light.types import LightBlock, SignedHeader
+from ..types.block import (
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+)
+from ..types.validator_set import Validator, ValidatorSet
+
+
+class RPCClientError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"rpc error {code}: {message}")
+        self.code = code
+
+
+class HTTPClient:
+    def __init__(self, base_url: str):
+        self.base_url = base_url.rstrip("/")
+        self._session: aiohttp.ClientSession | None = None
+        self._id = 0
+
+    async def _ensure(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def call(self, method: str, **params: Any) -> dict:
+        session = await self._ensure()
+        self._id += 1
+        body = {
+            "jsonrpc": "2.0",
+            "id": self._id,
+            "method": method,
+            "params": {k: v for k, v in params.items() if v is not None},
+        }
+        async with session.post(self.base_url + "/", json=body) as resp:
+            payload = await resp.json()
+        if "error" in payload:
+            raise RPCClientError(
+                payload["error"].get("code", -1), payload["error"].get("message", "")
+            )
+        return payload["result"]
+
+    # typed conveniences (the surface of reference rpc/client/interface.go)
+
+    async def status(self) -> dict:
+        return await self.call("status")
+
+    async def block(self, height: int | None = None) -> dict:
+        return await self.call("block", height=height)
+
+    async def commit(self, height: int | None = None) -> dict:
+        return await self.call("commit", height=height)
+
+    async def validators(self, height: int | None = None, page: int = 1, per_page: int = 100) -> dict:
+        return await self.call("validators", height=height, page=page, per_page=per_page)
+
+    async def broadcast_tx_sync(self, tx: bytes) -> dict:
+        return await self.call("broadcast_tx_sync", tx=tx.hex())
+
+    async def broadcast_tx_commit(self, tx: bytes) -> dict:
+        return await self.call("broadcast_tx_commit", tx=tx.hex())
+
+    async def abci_query(self, path: str, data: bytes) -> dict:
+        return await self.call("abci_query", path=path, data=data.hex())
+
+    async def tx(self, hash_: bytes) -> dict:
+        return await self.call("tx", hash=hash_.hex())
+
+    async def tx_search(self, query: str) -> dict:
+        return await self.call("tx_search", query=query)
+
+    async def websocket_events(self, query: str) -> AsyncIterator[dict]:
+        """Subscribe over the websocket endpoint and yield events."""
+        session = await self._ensure()
+        ws_url = self.base_url + "/websocket"
+        async with session.ws_connect(ws_url) as ws:
+            await ws.send_json(
+                {"jsonrpc": "2.0", "id": 1, "method": "subscribe", "params": {"query": query}}
+            )
+            first = await ws.receive_json()  # ack
+            if "error" in first:
+                raise RPCClientError(-1, str(first["error"]))
+            async for raw in ws:
+                if raw.type != aiohttp.WSMsgType.TEXT:
+                    break
+                msg = json.loads(raw.data)
+                if "result" in msg and msg["result"]:
+                    yield msg["result"]
+
+
+# -- JSON → domain decoding helpers ----------------------------------------
+
+
+def _decode_block_id(d: dict) -> BlockID:
+    return BlockID(
+        bytes.fromhex(d["hash"]),
+        PartSetHeader(d["parts"]["total"], bytes.fromhex(d["parts"]["hash"])),
+    )
+
+
+def _decode_header(d: dict) -> Header:
+    return Header(
+        chain_id=d["chain_id"],
+        height=int(d["height"]),
+        time_ns=int(d["time"]),
+        last_block_id=_decode_block_id(d["last_block_id"]),
+        last_commit_hash=bytes.fromhex(d["last_commit_hash"]),
+        data_hash=bytes.fromhex(d["data_hash"]),
+        validators_hash=bytes.fromhex(d["validators_hash"]),
+        next_validators_hash=bytes.fromhex(d["next_validators_hash"]),
+        consensus_hash=bytes.fromhex(d["consensus_hash"]),
+        app_hash=bytes.fromhex(d["app_hash"]),
+        last_results_hash=bytes.fromhex(d["last_results_hash"]),
+        evidence_hash=bytes.fromhex(d["evidence_hash"]),
+        proposer_address=bytes.fromhex(d["proposer_address"]),
+        version=int(d["version"]["block"]),
+    )
+
+
+def _decode_commit(d: dict) -> Commit:
+    sigs = tuple(
+        CommitSig(
+            flag=s["block_id_flag"],
+            validator_address=bytes.fromhex(s["validator_address"]),
+            timestamp_ns=int(s["timestamp"]),
+            signature=bytes.fromhex(s["signature"]) if s["signature"] else b"",
+        )
+        for s in d["signatures"]
+    )
+    return Commit(int(d["height"]), d["round"], _decode_block_id(d["block_id"]), sigs)
+
+
+class HTTPProvider(Provider):
+    """Light-client provider over RPC (reference light/provider/http)."""
+
+    def __init__(self, chain_id: str, client: HTTPClient):
+        self._chain_id = chain_id
+        self.client = client
+
+    def chain_id(self) -> str:
+        return self._chain_id
+
+    async def light_block(self, height: int) -> LightBlock:
+        try:
+            com = await self.client.commit(height or None)
+            h = int(com["signed_header"]["header"]["height"])
+            vals = await self.client.validators(h)
+        except RPCClientError as e:
+            raise LightBlockNotFoundError(str(e)) from e
+        except aiohttp.ClientError as e:
+            raise ProviderError(str(e)) from e
+        from ..crypto import pubkey_from_type_and_bytes
+
+        validators = ValidatorSet(
+            [
+                Validator(
+                    pubkey_from_type_and_bytes(
+                        v["pub_key"]["type"], bytes.fromhex(v["pub_key"]["value"])
+                    ),
+                    int(v["voting_power"]),
+                    int(v["proposer_priority"]),
+                )
+                for v in vals["validators"]
+            ]
+        )
+        header = _decode_header(com["signed_header"]["header"])
+        commit = _decode_commit(com["signed_header"]["commit"])
+        return LightBlock(SignedHeader(header, commit), validators)
+
+    async def report_evidence(self, evidence) -> None:
+        await self.client.call("broadcast_evidence", evidence=evidence.encode().hex())

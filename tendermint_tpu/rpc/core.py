@@ -1,0 +1,506 @@
+"""RPC handlers + the Environment they close over (reference
+internal/rpc/core/env.go and the per-domain handler files). All handlers
+return JSON-ready dicts; bytes are hex-encoded (upper-case hashes, like
+the reference's JSON)."""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..abci import types as abci
+from ..crypto.hashes import sha256
+from ..libs.pubsub import Query
+from ..mempool.pool import TxInCacheError, TxRejectedError
+from ..state.indexer import KVSink
+from ..types.events import EventBus
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+def _hex(b: bytes) -> str:
+    return b.hex().upper()
+
+
+def _block_id_json(bid) -> dict:
+    return {
+        "hash": _hex(bid.hash),
+        "parts": {
+            "total": bid.part_set_header.total,
+            "hash": _hex(bid.part_set_header.hash),
+        },
+    }
+
+
+def _header_json(h) -> dict:
+    return {
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": str(h.time_ns),
+        "last_block_id": _block_id_json(h.last_block_id),
+        "last_commit_hash": _hex(h.last_commit_hash),
+        "data_hash": _hex(h.data_hash),
+        "validators_hash": _hex(h.validators_hash),
+        "next_validators_hash": _hex(h.next_validators_hash),
+        "consensus_hash": _hex(h.consensus_hash),
+        "app_hash": _hex(h.app_hash),
+        "last_results_hash": _hex(h.last_results_hash),
+        "evidence_hash": _hex(h.evidence_hash),
+        "proposer_address": _hex(h.proposer_address),
+        "version": {"block": str(h.version)},
+    }
+
+
+def _commit_json(c) -> dict:
+    return {
+        "height": str(c.height),
+        "round": c.round,
+        "block_id": _block_id_json(c.block_id),
+        "signatures": [
+            {
+                "block_id_flag": s.flag,
+                "validator_address": _hex(s.validator_address),
+                "timestamp": str(s.timestamp_ns),
+                "signature": s.signature.hex() if s.signature else None,
+            }
+            for s in c.signatures
+        ],
+    }
+
+
+def _block_json(b) -> dict:
+    return {
+        "header": _header_json(b.header),
+        "data": {"txs": [tx.hex() for tx in b.txs]},
+        "evidence": {"evidence": [ev.encode().hex() for ev in b.evidence]},
+        "last_commit": _commit_json(b.last_commit) if b.last_commit else None,
+    }
+
+
+def _validator_json(v) -> dict:
+    return {
+        "address": _hex(v.address),
+        "pub_key": {"type": v.pub_key.TYPE, "value": v.pub_key.bytes().hex()},
+        "voting_power": str(v.voting_power),
+        "proposer_priority": str(v.proposer_priority),
+    }
+
+
+def _tx_result_json(r) -> dict:
+    return {
+        "hash": _hex(r.hash),
+        "height": str(r.height),
+        "index": r.index,
+        "tx": r.tx.hex(),
+        "tx_result": {
+            "code": r.code,
+            "data": r.data.hex(),
+            "log": r.log,
+            "events": r.events,
+        },
+    }
+
+
+@dataclass
+class Environment:
+    """Everything the handlers reach into (reference env.go)."""
+
+    chain_id: str
+    genesis_doc: Any = None
+    state_store: Any = None
+    block_store: Any = None
+    mempool: Any = None
+    evidence_pool: Any = None
+    consensus: Any = None
+    app_conns: Any = None
+    event_bus: EventBus | None = None
+    sink: KVSink | None = None
+    peer_manager: Any = None
+    node_info: Any = None
+    logger: logging.Logger = field(default_factory=lambda: logging.getLogger("rpc"))
+
+    # ------------------------------------------------------------------
+    # info routes
+    # ------------------------------------------------------------------
+
+    async def health(self) -> dict:
+        return {}
+
+    async def status(self) -> dict:
+        height = self.block_store.height()
+        meta = self.block_store.load_block_meta(height) if height else None
+        state = self.state_store.load()
+        val_info = {}
+        if self.consensus is not None and self.consensus.priv_validator is not None:
+            pub = self.consensus.priv_validator.get_pub_key()
+            power = 0
+            if state is not None and state.validators is not None:
+                _, val = state.validators.get_by_address(pub.address())
+                power = val.voting_power if val else 0
+            val_info = {
+                "address": _hex(pub.address()),
+                "pub_key": {"type": pub.TYPE, "value": pub.bytes().hex()},
+                "voting_power": str(power),
+            }
+        return {
+            "node_info": {
+                "id": self.node_info.node_id if self.node_info else "",
+                "network": self.chain_id,
+                "moniker": self.node_info.moniker if self.node_info else "",
+            },
+            "sync_info": {
+                "latest_block_height": str(height),
+                "latest_block_hash": _hex(meta.block_id.hash) if meta else "",
+                "latest_app_hash": _hex(state.app_hash) if state else "",
+                "latest_block_time": str(meta.header.time_ns) if meta else "0",
+                "earliest_block_height": str(self.block_store.base()),
+                "catching_up": False,
+            },
+            "validator_info": val_info,
+        }
+
+    async def net_info(self) -> dict:
+        peers = self.peer_manager.connected_peers() if self.peer_manager else []
+        return {
+            "listening": True,
+            "n_peers": str(len(peers)),
+            "peers": [{"node_id": p} for p in peers],
+        }
+
+    async def genesis(self) -> dict:
+        return {"genesis": self.genesis_doc.to_json() if self.genesis_doc else None}
+
+    async def consensus_params(self, height: int | None = None) -> dict:
+        state = self.state_store.load()
+        h = int(height) if height else state.last_block_height + 1
+        params = self.state_store.load_consensus_params(h) or state.consensus_params
+        return {
+            "block_height": str(h),
+            "consensus_params": {
+                "block": {
+                    "max_bytes": str(params.block.max_bytes),
+                    "max_gas": str(params.block.max_gas),
+                },
+                "evidence": {
+                    "max_age_num_blocks": str(params.evidence.max_age_num_blocks),
+                    "max_age_duration": str(params.evidence.max_age_duration_ns),
+                    "max_bytes": str(params.evidence.max_bytes),
+                },
+                "validator": {
+                    "pub_key_types": list(params.validator.pub_key_types)
+                },
+            },
+        }
+
+    async def consensus_state(self) -> dict:
+        if self.consensus is None:
+            raise RPCError(-32603, "consensus not running")
+        rs = self.consensus.rs
+        return {
+            "round_state": {
+                "height": str(rs.height),
+                "round": rs.round,
+                "step": rs.step.name,
+                "proposal": rs.proposal is not None,
+                "proposal_block_hash": _hex(rs.proposal_block.hash())
+                if rs.proposal_block
+                else None,
+                "locked_round": rs.locked_round,
+                "valid_round": rs.valid_round,
+            }
+        }
+
+    # ------------------------------------------------------------------
+    # block routes
+    # ------------------------------------------------------------------
+
+    def _height_or_latest(self, height) -> int:
+        if height in (None, 0, "0", ""):
+            return self.block_store.height()
+        h = int(height)
+        if h <= 0:
+            raise RPCError(-32602, f"height must be positive, got {h}")
+        if h > self.block_store.height():
+            raise RPCError(
+                -32602,
+                f"height {h} beyond store height {self.block_store.height()}",
+            )
+        return h
+
+    async def block(self, height: int | None = None) -> dict:
+        h = self._height_or_latest(height)
+        block = self.block_store.load_block(h)
+        meta = self.block_store.load_block_meta(h)
+        if block is None or meta is None:
+            raise RPCError(-32603, f"no block at height {h}")
+        return {"block_id": _block_id_json(meta.block_id), "block": _block_json(block)}
+
+    async def block_by_hash(self, hash: str) -> dict:
+        block = self.block_store.load_block_by_hash(bytes.fromhex(hash))
+        if block is None:
+            raise RPCError(-32603, f"no block with hash {hash}")
+        return await self.block(block.header.height)
+
+    async def header(self, height: int | None = None) -> dict:
+        h = self._height_or_latest(height)
+        meta = self.block_store.load_block_meta(h)
+        if meta is None:
+            raise RPCError(-32603, f"no header at height {h}")
+        return {"header": _header_json(meta.header)}
+
+    async def commit(self, height: int | None = None) -> dict:
+        h = self._height_or_latest(height)
+        meta = self.block_store.load_block_meta(h)
+        commit = self.block_store.load_block_commit(h)
+        canonical = commit is not None
+        if commit is None:
+            commit = self.block_store.load_seen_commit(h)
+        if meta is None or commit is None:
+            raise RPCError(-32603, f"no commit at height {h}")
+        return {
+            "signed_header": {
+                "header": _header_json(meta.header),
+                "commit": _commit_json(commit),
+            },
+            "canonical": canonical,
+        }
+
+    async def blockchain(self, minHeight: int | None = None, maxHeight: int | None = None) -> dict:
+        max_h = self._height_or_latest(maxHeight)
+        min_h = max(int(minHeight or 1), self.block_store.base())
+        max_h = min(max_h, min_h + 19)  # page limit, reference limits to 20
+        metas = []
+        for h in range(max_h, min_h - 1, -1):
+            meta = self.block_store.load_block_meta(h)
+            if meta is not None:
+                metas.append(
+                    {
+                        "block_id": _block_id_json(meta.block_id),
+                        "block_size": str(meta.block_size),
+                        "header": _header_json(meta.header),
+                        "num_txs": str(meta.num_txs),
+                    }
+                )
+        return {
+            "last_height": str(self.block_store.height()),
+            "block_metas": metas,
+        }
+
+    async def block_results(self, height: int | None = None) -> dict:
+        h = self._height_or_latest(height)
+        responses = self.state_store.load_abci_responses(h)
+        if responses is None:
+            raise RPCError(-32603, f"no results for height {h}")
+        return {
+            "height": str(h),
+            "txs_results": [
+                {"code": r.code, "data": r.data.hex(), "log": r.log,
+                 "gas_wanted": str(r.gas_wanted), "gas_used": str(r.gas_used)}
+                for r in responses.deliver_txs
+            ],
+            "validator_updates": [
+                {"pub_key": u.pub_key.hex(), "power": str(u.power)}
+                for u in responses.end_block.validator_updates
+            ],
+        }
+
+    async def validators(
+        self, height: int | None = None, page: int = 1, per_page: int = 30
+    ) -> dict:
+        state = self.state_store.load()
+        h = int(height) if height else state.last_block_height + 1
+        vals = self.state_store.load_validators(h)
+        if vals is None:
+            raise RPCError(-32603, f"no validator set at height {h}")
+        page, per_page = max(int(page), 1), min(int(per_page), 100)
+        start = (page - 1) * per_page
+        chunk = vals.validators[start : start + per_page]
+        return {
+            "block_height": str(h),
+            "validators": [_validator_json(v) for v in chunk],
+            "count": str(len(chunk)),
+            "total": str(len(vals)),
+        }
+
+    # ------------------------------------------------------------------
+    # tx routes
+    # ------------------------------------------------------------------
+
+    async def broadcast_tx_async(self, tx: str) -> dict:
+        raw = bytes.fromhex(tx)
+        import asyncio
+
+        asyncio.get_running_loop().create_task(self._checktx_quiet(raw))
+        return {"code": 0, "hash": _hex(sha256(raw)), "log": ""}
+
+    async def _checktx_quiet(self, raw: bytes) -> None:
+        try:
+            await self.mempool.check_tx(raw)
+        except Exception:
+            pass
+
+    async def broadcast_tx_sync(self, tx: str) -> dict:
+        raw = bytes.fromhex(tx)
+        try:
+            await self.mempool.check_tx(raw)
+        except TxInCacheError:
+            return {"code": 0, "hash": _hex(sha256(raw)), "log": "tx already in cache"}
+        except TxRejectedError as e:
+            return {"code": e.code or 1, "hash": _hex(sha256(raw)), "log": e.log}
+        return {"code": 0, "hash": _hex(sha256(raw)), "log": ""}
+
+    async def broadcast_tx_commit(self, tx: str, timeout: float = 30.0) -> dict:
+        """Submit and wait for the tx to be committed (reference
+        rpc/core/mempool.go BroadcastTxCommit — subscribes first)."""
+        import asyncio
+
+        raw = bytes.fromhex(tx)
+        h = sha256(raw)
+        if self.event_bus is None:
+            raise RPCError(-32603, "event bus unavailable")
+        q = Query.parse(f"tm.event='Tx' AND tx.hash='{_hex(h)}'")
+        sub = self.event_bus.subscribe(f"btc-{h.hex()[:16]}", q, buffer=1)
+        try:
+            res = await self.broadcast_tx_sync(tx)
+            if res["code"] != 0:
+                return {"check_tx": res, "deliver_tx": None, "hash": _hex(h), "height": "0"}
+            msg = await asyncio.wait_for(sub.next(), timeout)
+            data = msg.data
+            r = data.result
+            return {
+                "check_tx": res,
+                "deliver_tx": {"code": r.code, "data": r.data.hex(), "log": r.log},
+                "hash": _hex(h),
+                "height": str(data.height),
+            }
+        except asyncio.TimeoutError:
+            raise RPCError(-32603, "timed out waiting for tx to be committed")
+        finally:
+            self.event_bus.unsubscribe_all(f"btc-{h.hex()[:16]}")
+
+    async def unconfirmed_txs(self, limit: int = 30) -> dict:
+        txs = self.mempool.reap_max_txs(int(limit))
+        return {
+            "n_txs": str(len(txs)),
+            "total": str(self.mempool.size()),
+            "total_bytes": str(self.mempool.size_bytes()),
+            "txs": [t.hex() for t in txs],
+        }
+
+    async def num_unconfirmed_txs(self) -> dict:
+        return {
+            "n_txs": str(self.mempool.size()),
+            "total": str(self.mempool.size()),
+            "total_bytes": str(self.mempool.size_bytes()),
+        }
+
+    async def check_tx(self, tx: str) -> dict:
+        res = await self.app_conns.mempool.check_tx(
+            abci.RequestCheckTx(bytes.fromhex(tx))
+        )
+        return {"code": res.code, "log": res.log, "gas_wanted": str(res.gas_wanted)}
+
+    async def tx(self, hash: str) -> dict:
+        if self.sink is None:
+            raise RPCError(-32603, "indexing disabled")
+        res = self.sink.get_tx(bytes.fromhex(hash))
+        if res is None:
+            raise RPCError(-32603, f"tx {hash} not found")
+        return _tx_result_json(res)
+
+    async def tx_search(self, query: str, per_page: int = 30, **_kw) -> dict:
+        if self.sink is None:
+            raise RPCError(-32603, "indexing disabled")
+        results = self.sink.search_txs(Query.parse(query), limit=int(per_page))
+        return {
+            "txs": [_tx_result_json(r) for r in results],
+            "total_count": str(len(results)),
+        }
+
+    async def block_search(self, query: str, per_page: int = 30, **_kw) -> dict:
+        if self.sink is None:
+            raise RPCError(-32603, "indexing disabled")
+        heights = self.sink.search_blocks(Query.parse(query), limit=int(per_page))
+        blocks = []
+        for h in heights:
+            try:
+                blocks.append(await self.block(h))
+            except RPCError:
+                continue
+        return {"blocks": blocks, "total_count": str(len(blocks))}
+
+    # ------------------------------------------------------------------
+    # abci + evidence
+    # ------------------------------------------------------------------
+
+    async def abci_info(self) -> dict:
+        res = await self.app_conns.query.info(abci.RequestInfo())
+        return {
+            "response": {
+                "data": res.data,
+                "version": res.version,
+                "app_version": str(res.app_version),
+                "last_block_height": str(res.last_block_height),
+                "last_block_app_hash": res.last_block_app_hash.hex(),
+            }
+        }
+
+    async def abci_query(
+        self, path: str = "", data: str = "", height: int = 0, prove: bool = False
+    ) -> dict:
+        res = await self.app_conns.query.query(
+            abci.RequestQuery(
+                data=bytes.fromhex(data), path=path, height=int(height), prove=bool(prove)
+            )
+        )
+        return {
+            "response": {
+                "code": res.code,
+                "log": res.log,
+                "key": res.key.hex(),
+                "value": res.value.hex(),
+                "height": str(res.height),
+            }
+        }
+
+    async def broadcast_evidence(self, evidence: str) -> dict:
+        from ..types.evidence import decode_evidence
+
+        ev = decode_evidence(bytes.fromhex(evidence))
+        self.evidence_pool.add_evidence(ev)
+        return {"hash": _hex(ev.hash())}
+
+
+ROUTES = [
+    "health",
+    "status",
+    "net_info",
+    "genesis",
+    "consensus_params",
+    "consensus_state",
+    "block",
+    "block_by_hash",
+    "header",
+    "commit",
+    "blockchain",
+    "block_results",
+    "validators",
+    "broadcast_tx_async",
+    "broadcast_tx_sync",
+    "broadcast_tx_commit",
+    "unconfirmed_txs",
+    "num_unconfirmed_txs",
+    "check_tx",
+    "tx",
+    "tx_search",
+    "block_search",
+    "abci_info",
+    "abci_query",
+    "broadcast_evidence",
+]

@@ -1,0 +1,183 @@
+"""JSON-RPC server (reference rpc/jsonrpc/server): HTTP POST body JSON-RPC
+2.0, GET URI-style calls (/status?height=5), and a /websocket endpoint
+for event subscriptions (subscribe/unsubscribe with pubsub queries)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from aiohttp import WSMsgType, web
+
+from ..libs.pubsub import Query
+from .core import ROUTES, Environment, RPCError
+
+
+def _rpc_response(id_, result=None, error=None) -> dict:
+    out = {"jsonrpc": "2.0", "id": id_}
+    if error is not None:
+        out["error"] = error
+    else:
+        out["result"] = result
+    return out
+
+
+def _event_json(msg) -> dict:
+    """Best-effort JSON for a pubsub event message."""
+    data = msg.data
+    d: dict = {"type": type(data).__name__}
+    for attr in ("height", "round", "step", "index"):
+        if hasattr(data, attr):
+            d[attr] = getattr(data, attr)
+    if hasattr(data, "tx"):
+        d["tx"] = data.tx.hex()
+    if hasattr(data, "block") and data.block is not None:
+        d["block_height"] = data.block.header.height
+        d["block_hash"] = data.block.hash().hex().upper()
+    return {"query": str(msg.events or {}), "data": d, "events": msg.events}
+
+
+class RPCServer:
+    def __init__(
+        self,
+        env: Environment,
+        *,
+        logger: logging.Logger | None = None,
+    ):
+        self.env = env
+        self.logger = logger or logging.getLogger("rpc.server")
+        self.app = web.Application()
+        self.app.router.add_post("/", self._handle_jsonrpc)
+        self.app.router.add_get("/websocket", self._handle_ws)
+        for name in ROUTES:
+            self.app.router.add_get(f"/{name}", self._make_uri_handler(name))
+        self._runner: web.AppRunner | None = None
+        self._site: web.TCPSite | None = None
+        self.port: int | None = None
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        self._site = web.TCPSite(self._runner, host, port)
+        await self._site.start()
+        self.port = self._site._server.sockets[0].getsockname()[1]
+        self.logger.info("RPC listening on %s:%d", host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # -- dispatch --------------------------------------------------------
+
+    async def _call(self, method: str, params: dict):
+        if method not in ROUTES:
+            raise RPCError(-32601, f"method {method!r} not found")
+        handler = getattr(self.env, method)
+        return await handler(**(params or {}))
+
+    async def _handle_jsonrpc(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response(
+                _rpc_response(None, error={"code": -32700, "message": "parse error"})
+            )
+        calls = body if isinstance(body, list) else [body]
+        responses = []
+        for call in calls:
+            id_ = call.get("id")
+            try:
+                result = await self._call(call.get("method", ""), call.get("params") or {})
+                responses.append(_rpc_response(id_, result))
+            except RPCError as e:
+                responses.append(
+                    _rpc_response(id_, error={"code": e.code, "message": e.message})
+                )
+            except TypeError as e:
+                responses.append(
+                    _rpc_response(id_, error={"code": -32602, "message": str(e)})
+                )
+            except Exception as e:
+                self.logger.exception("rpc %s failed", call.get("method"))
+                responses.append(
+                    _rpc_response(id_, error={"code": -32603, "message": repr(e)})
+                )
+        payload = responses if isinstance(body, list) else responses[0]
+        return web.json_response(payload)
+
+    def _make_uri_handler(self, name: str):
+        async def handler(request: web.Request) -> web.Response:
+            params = dict(request.query)
+            try:
+                result = await self._call(name, params)
+                return web.json_response(_rpc_response(-1, result))
+            except RPCError as e:
+                return web.json_response(
+                    _rpc_response(-1, error={"code": e.code, "message": e.message})
+                )
+            except Exception as e:
+                return web.json_response(
+                    _rpc_response(-1, error={"code": -32603, "message": repr(e)})
+                )
+
+        return handler
+
+    # -- websocket subscriptions ----------------------------------------
+
+    async def _handle_ws(self, request: web.Request) -> web.WebSocketResponse:
+        ws = web.WebSocketResponse()
+        await ws.prepare(request)
+        subscriber = f"ws-{id(ws)}"
+        pumps: list[asyncio.Task] = []
+        try:
+            async for raw in ws:
+                if raw.type != WSMsgType.TEXT:
+                    continue
+                try:
+                    call = json.loads(raw.data)
+                except json.JSONDecodeError:
+                    continue
+                method = call.get("method")
+                id_ = call.get("id")
+                params = call.get("params") or {}
+                if method == "subscribe":
+                    try:
+                        q = Query.parse(params["query"])
+                    except Exception as e:
+                        await ws.send_json(
+                            _rpc_response(id_, error={"code": -32602, "message": str(e)})
+                        )
+                        continue
+                    sub = self.env.event_bus.subscribe(subscriber, q, buffer=256)
+                    pumps.append(
+                        asyncio.get_running_loop().create_task(
+                            self._pump(ws, id_, sub)
+                        )
+                    )
+                    await ws.send_json(_rpc_response(id_, {}))
+                elif method == "unsubscribe_all" or method == "unsubscribe":
+                    self.env.event_bus.unsubscribe_all(subscriber)
+                    await ws.send_json(_rpc_response(id_, {}))
+                else:
+                    try:
+                        result = await self._call(method, params)
+                        await ws.send_json(_rpc_response(id_, result))
+                    except RPCError as e:
+                        await ws.send_json(
+                            _rpc_response(id_, error={"code": e.code, "message": e.message})
+                        )
+        finally:
+            self.env.event_bus.unsubscribe_all(subscriber)
+            for p in pumps:
+                p.cancel()
+        return ws
+
+    async def _pump(self, ws, id_, sub) -> None:
+        try:
+            async for msg in sub:
+                await ws.send_json(_rpc_response(id_, _event_json(msg)))
+        except Exception:
+            pass

@@ -1,0 +1,6 @@
+"""Version constants (reference version/version.go:13-15)."""
+
+VERSION = "0.1.0"
+ABCI_VERSION = "0.17.0"
+BLOCK_PROTOCOL = 1
+P2P_PROTOCOL = 1

@@ -1,0 +1,129 @@
+"""RPC layer tests: JSON-RPC over HTTP, URI routes, websocket
+subscriptions, the RPC client, and the HTTP light-client provider
+(modeled on reference rpc/jsonrpc tests + rpc/client tests)."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu.crypto.hashes import sha256
+from tendermint_tpu.p2p.types import NodeAddress
+from tendermint_tpu.rpc.client import HTTPClient, HTTPProvider, RPCClientError
+from tests.test_node import NodeNet
+
+LONG_NS = 10 * 365 * 24 * 3600 * 10**9
+
+
+async def rpc_net(n=2):
+    net = NodeNet(n)
+    for node in net.nodes:
+        node.config.rpc_laddr = "127.0.0.1:0"
+    await net.start()
+    await net.wait_for_height(2, timeout=60)
+    clients = [
+        HTTPClient(f"http://127.0.0.1:{node.rpc_server.port}") for node in net.nodes
+    ]
+    return net, clients
+
+
+class TestRPC:
+    @pytest.mark.asyncio
+    async def test_status_block_commit_validators(self):
+        net, clients = await rpc_net()
+        c = clients[0]
+        try:
+            st = await c.status()
+            assert int(st["sync_info"]["latest_block_height"]) >= 2
+            blk = await c.block(1)
+            assert blk["block"]["header"]["height"] == "1"
+            com = await c.commit(1)
+            assert com["signed_header"]["commit"]["height"] == "1"
+            vals = await c.validators(1)
+            assert int(vals["total"]) == 2
+            # URI-style GET works too
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(c.base_url + "/health") as resp:
+                    body = await resp.json()
+                    assert body["result"] == {}
+        finally:
+            for cl in clients:
+                await cl.close()
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_broadcast_tx_commit_and_query(self):
+        net, clients = await rpc_net()
+        c = clients[0]
+        try:
+            res = await c.broadcast_tx_commit(b"neptune=blue")
+            assert res["check_tx"]["code"] == 0
+            assert res["deliver_tx"]["code"] == 0
+            height = int(res["height"])
+            assert height > 0
+            # app query via RPC
+            q = await c.abci_query("", b"neptune")
+            assert bytes.fromhex(q["response"]["value"]) == b"blue"
+            # indexed tx lookup + search
+            tx = await c.tx(sha256(b"neptune=blue"))
+            assert bytes.fromhex(tx["tx"]) == b"neptune=blue"
+            found = await c.tx_search(f"tx.height={height}")
+            assert int(found["total_count"]) >= 1
+        finally:
+            for cl in clients:
+                await cl.close()
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_error_handling(self):
+        net, clients = await rpc_net()
+        c = clients[0]
+        try:
+            with pytest.raises(RPCClientError):
+                await c.block(10**9)
+            with pytest.raises(RPCClientError):
+                await c.call("no_such_method")
+        finally:
+            for cl in clients:
+                await cl.close()
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_websocket_subscription(self):
+        net, clients = await rpc_net()
+        c = clients[0]
+        try:
+            events = c.websocket_events("tm.event='NewBlock'")
+            got = await asyncio.wait_for(events.__anext__(), 20)
+            assert got["data"]["type"] == "EventDataNewBlock"
+            assert got["data"]["block_height"] >= 1
+        finally:
+            for cl in clients:
+                await cl.close()
+            await net.stop()
+
+
+class TestHTTPProvider:
+    @pytest.mark.asyncio
+    async def test_light_client_over_rpc(self):
+        from tendermint_tpu.light.client import LightClient, TrustOptions
+
+        net, clients = await rpc_net()
+        try:
+            await net.wait_for_height(3, timeout=60)
+            provider = HTTPProvider(net.genesis.chain_id, clients[0])
+            lb1 = await provider.light_block(1)
+            assert lb1.height == 1
+            lb1.validate_basic(net.genesis.chain_id)
+            client = LightClient(
+                net.genesis.chain_id,
+                TrustOptions(LONG_NS, 1, lb1.header.hash()),
+                provider,
+            )
+            lb3 = await client.verify_light_block_at_height(3)
+            assert lb3.height == 3
+        finally:
+            for cl in clients:
+                await cl.close()
+            await net.stop()
