@@ -73,7 +73,11 @@ def cmd_init(args) -> int:
         with open(p["config_toml"], "w") as f:
             f.write(config_to_toml(cfg))
     node_key = _load_or_gen_node_key(p["node_key"])
-    pv = FilePV.load_or_generate(p["pv_key"], p["pv_state"])
+    pv = (
+        FilePV.load_or_generate(p["pv_key"], p["pv_state"])
+        if args.mode == "validator"
+        else None
+    )
     if not os.path.exists(p["genesis"]):
         import time
 
@@ -104,9 +108,10 @@ def _build_node(home: str):
     with open(p["genesis"]) as f:
         genesis = GenesisDoc.from_json(f.read())
     node_key = _load_or_gen_node_key(p["node_key"])
+    # only homes initialized with a validator key sign (init full → none)
     pv = (
-        FilePV.load_or_generate(p["pv_key"], p["pv_state"])
-        if os.path.exists(p["pv_key"]) or True
+        FilePV.load(p["pv_key"], p["pv_state"])
+        if os.path.exists(p["pv_key"])
         else None
     )
     if cfg.proxy_app == "kvstore":
@@ -140,6 +145,7 @@ def _build_node(home: str):
         block_db=SQLiteDB(os.path.join(p["data"], "blockstore.db")),
         state_db=SQLiteDB(os.path.join(p["data"], "state.db")),
         evidence_db=SQLiteDB(os.path.join(p["data"], "evidence.db")),
+        index_db=SQLiteDB(os.path.join(p["data"], "tx_index.db")),
     )
     return node, cfg, transport
 
@@ -254,7 +260,7 @@ def cmd_reset(args) -> int:
     unsafe-reset-all)."""
     home = _home(args)
     p = _paths(home)
-    for name in ("blockstore.db", "state.db", "evidence.db", "app.db", "cs.wal"):
+    for name in ("blockstore.db", "state.db", "evidence.db", "app.db", "tx_index.db", "cs.wal"):
         path = os.path.join(p["data"], name)
         if os.path.isdir(path):
             shutil.rmtree(path)

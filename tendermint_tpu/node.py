@@ -92,6 +92,7 @@ class Node(Service):
         block_db: DB | None = None,
         state_db: DB | None = None,
         evidence_db: DB | None = None,
+        index_db: DB | None = None,
         logger: logging.Logger | None = None,
     ):
         super().__init__("node", logger)
@@ -105,6 +106,7 @@ class Node(Service):
         self.block_store = BlockStore(block_db or MemDB())
         self.state_store = StateStore(state_db or MemDB())
         self.evidence_db = evidence_db or MemDB()
+        self.index_db = index_db or MemDB()
         self.event_bus = EventBus()
 
         self.node_info = NodeInfo(
@@ -260,7 +262,7 @@ class Node(Service):
         if self.config.tx_index:
             from .state.indexer import IndexerService, KVSink
 
-            self.sink = KVSink(MemDB())
+            self.sink = KVSink(self.index_db)
             self.indexer = IndexerService(self.sink, self.event_bus)
             await self.indexer.start()
 

@@ -164,7 +164,16 @@ class HTTPProvider(Provider):
         try:
             com = await self.client.commit(height or None)
             h = int(com["signed_header"]["header"]["height"])
-            vals = await self.client.validators(h)
+            # paginate: sets larger than one page must be fetched fully or
+            # the reconstructed hash won't match the header
+            raw_vals: list[dict] = []
+            page = 1
+            while True:
+                vals = await self.client.validators(h, page=page, per_page=100)
+                raw_vals.extend(vals["validators"])
+                if len(raw_vals) >= int(vals["total"]) or not vals["validators"]:
+                    break
+                page += 1
         except RPCClientError as e:
             raise LightBlockNotFoundError(str(e)) from e
         except aiohttp.ClientError as e:
@@ -180,7 +189,7 @@ class HTTPProvider(Provider):
                     int(v["voting_power"]),
                     int(v["proposer_priority"]),
                 )
-                for v in vals["validators"]
+                for v in raw_vals
             ]
         )
         header = _decode_header(com["signed_header"]["header"])

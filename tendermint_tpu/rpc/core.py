@@ -359,17 +359,37 @@ class Environment:
         """Submit and wait for the tx to be committed (reference
         rpc/core/mempool.go BroadcastTxCommit — subscribes first)."""
         import asyncio
+        import uuid
 
         raw = bytes.fromhex(tx)
         h = sha256(raw)
         if self.event_bus is None:
             raise RPCError(-32603, "event bus unavailable")
         q = Query.parse(f"tm.event='Tx' AND tx.hash='{_hex(h)}'")
-        sub = self.event_bus.subscribe(f"btc-{h.hex()[:16]}", q, buffer=1)
+        # unique subscriber id: concurrent commits of the same tx must not
+        # collide on the (subscriber, query) key
+        subscriber = f"btc-{uuid.uuid4().hex[:12]}"
+        sub = self.event_bus.subscribe(subscriber, q, buffer=1)
         try:
             res = await self.broadcast_tx_sync(tx)
             if res["code"] != 0:
                 return {"check_tx": res, "deliver_tx": None, "hash": _hex(h), "height": "0"}
+            if "already in cache" in res.get("log", ""):
+                # possibly committed long ago — answer from the index
+                # rather than waiting for an event that already fired
+                if self.sink is not None:
+                    prior = self.sink.get_tx(h)
+                    if prior is not None:
+                        return {
+                            "check_tx": res,
+                            "deliver_tx": {
+                                "code": prior.code,
+                                "data": prior.data.hex(),
+                                "log": prior.log,
+                            },
+                            "hash": _hex(h),
+                            "height": str(prior.height),
+                        }
             msg = await asyncio.wait_for(sub.next(), timeout)
             data = msg.data
             r = data.result
@@ -382,7 +402,7 @@ class Environment:
         except asyncio.TimeoutError:
             raise RPCError(-32603, "timed out waiting for tx to be committed")
         finally:
-            self.event_bus.unsubscribe_all(f"btc-{h.hex()[:16]}")
+            self.event_bus.unsubscribe_all(subscriber)
 
     async def unconfirmed_txs(self, limit: int = 30) -> dict:
         txs = self.mempool.reap_max_txs(int(limit))
@@ -454,6 +474,9 @@ class Environment:
     async def abci_query(
         self, path: str = "", data: str = "", height: int = 0, prove: bool = False
     ) -> dict:
+        # URI params arrive as strings: 'false'/'0' must mean False
+        if isinstance(prove, str):
+            prove = prove.lower() not in ("", "0", "false", "no")
         res = await self.app_conns.query.query(
             abci.RequestQuery(
                 data=bytes.fromhex(data), path=path, height=int(height), prove=bool(prove)

@@ -169,6 +169,16 @@ class RPCServer:
                         await ws.send_json(
                             _rpc_response(id_, error={"code": e.code, "message": e.message})
                         )
+                    except TypeError as e:
+                        await ws.send_json(
+                            _rpc_response(id_, error={"code": -32602, "message": str(e)})
+                        )
+                    except Exception as e:
+                        # one bad request must not tear down the socket
+                        # (and every live subscription with it)
+                        await ws.send_json(
+                            _rpc_response(id_, error={"code": -32603, "message": repr(e)})
+                        )
         finally:
             self.env.event_bus.unsubscribe_all(subscriber)
             for p in pumps:

@@ -30,6 +30,18 @@ _EVT = b"evt/"
 _BLK = b"bevt/"
 
 
+def _prefix_end(prefix: bytes) -> bytes:
+    """Exclusive upper bound covering every key with this prefix (DB
+    iterate is [start, end); a bare prefix+0xff bound would drop keys
+    whose next byte IS 0xff)."""
+    p = bytearray(prefix)
+    for i in reversed(range(len(p))):
+        if p[i] != 0xFF:
+            p[i] += 1
+            return bytes(p[: i + 1])
+    return None  # prefix is all 0xff: unbounded
+
+
 class TxResult:
     def __init__(
         self,
@@ -114,25 +126,21 @@ class KVSink:
             (c for c in query.conditions if c.op == "=" and c.key != "tm.event"),
             None,
         )
+        results_by_hash: dict[bytes, TxResult] = {}
         if eq is not None:
             prefix = _EVT + eq.key.encode() + b"/" + str(eq.operand).encode() + b"/"
-            seen = set()
-            for _k, h in self.db.iterate(prefix, prefix + b"\xff"):
-                if h not in seen:
-                    seen.add(h)
-                    hashes.append(h)
+            for _k, h in self.db.iterate(prefix, _prefix_end(prefix)):
+                if h not in results_by_hash:
+                    res = self.get_tx(h)
+                    if res is not None:
+                        results_by_hash[h] = res
         else:
-            seen = set()
-            for _k, raw in self.db.iterate(_TX, _TX + b"\xff"):
-                h = sha256(TxResult.from_json(raw).tx)
-                if h not in seen:
-                    seen.add(h)
-                    hashes.append(h)
+            for k, raw in self.db.iterate(_TX, _prefix_end(_TX)):
+                h = k[len(_TX):]  # key is _TX + hash
+                if h not in results_by_hash:
+                    results_by_hash[h] = TxResult.from_json(raw)
         out = []
-        for h in hashes:
-            res = self.get_tx(h)
-            if res is None:
-                continue
+        for h, res in results_by_hash.items():
             evmap = dict(res.events)
             evmap.setdefault("tx.height", [str(res.height)])
             evmap.setdefault("tx.hash", [res.hash.hex().upper()])
@@ -145,7 +153,7 @@ class KVSink:
 
     def search_blocks(self, query: Query, limit: int = 100) -> list[int]:
         out = []
-        for k, raw in self.db.iterate(_BLK, _BLK + b"\xff"):
+        for k, raw in self.db.iterate(_BLK, _prefix_end(_BLK)):
             height = int.from_bytes(k[len(_BLK):], "big")
             evmap = json.loads(raw)
             evmap.setdefault("block.height", [str(height)])
