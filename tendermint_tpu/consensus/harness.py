@@ -123,6 +123,7 @@ class Node:
     async def stop(self) -> None:
         if self.cs is not None:
             await self.cs.stop()
+        await self.app_conns.stop()
 
 
 class LocalNetwork:

@@ -39,6 +39,9 @@ from .mempool import MEMPOOL_CHANNEL
 from .mempool.pool import PriorityMempool
 from .mempool.reactor import MempoolReactor, decode_txs, encode_txs
 from .p2p.peermanager import PeerManager
+from .p2p.pex import PEX_CHANNEL, PexReactor
+from .p2p.pex import decode_message as pex_decode
+from .p2p.pex import encode_message as pex_encode
 from .p2p.router import Router
 from .p2p.transport import Transport
 from .p2p.types import NodeInfo, node_id_from_pubkey
@@ -129,6 +132,7 @@ class Node(Service):
         self.evidence_reactor: EvidenceReactor | None = None
         self.blocksync_reactor: BlockSyncReactor | None = None
         self.statesync_reactor: StateSyncReactor | None = None
+        self.pex_reactor: PexReactor | None = None
         self.indexer = None
         self.sink = None
         self.rpc_server = None
@@ -165,6 +169,10 @@ class Node(Service):
         self.blocksync_ch = r.open_channel(
             BLOCKSYNC_CHANNEL, name="blocksync", priority=5,
             encode=bs_msgs.encode_message, decode=bs_msgs.decode_message,
+        )
+        self.pex_ch = r.open_channel(
+            PEX_CHANNEL, name="pex", priority=1,
+            encode=pex_encode, decode=pex_decode,
         )
         for cid, name in (
             (SNAPSHOT_CHANNEL, "ss-snapshot"),
@@ -266,7 +274,12 @@ class Node(Service):
             self.indexer = IndexerService(self.sink, self.event_bus)
             await self.indexer.start()
 
+        self.pex_reactor = PexReactor(
+            self.peer_manager, self.pex_ch, self.peer_manager.subscribe()
+        )
+
         await self.router.start()
+        await self.pex_reactor.start()
         await self.mempool_reactor.start()
         await self.evidence_reactor.start()
         await self.statesync_reactor.start()
@@ -380,6 +393,7 @@ class Node(Service):
             self.statesync_reactor,
             self.evidence_reactor,
             self.mempool_reactor,
+            self.pex_reactor,
             self.indexer,
             self.router,
         ):

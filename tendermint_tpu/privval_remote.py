@@ -1,0 +1,272 @@
+"""Remote signer: a validator's key isolated in its own process
+(reference privval/signer_listener_endpoint.go:30 + signer_client.go:17).
+
+`SignerServer` runs beside the key (wrapping a FilePV) and serves signing
+requests over TCP; `SignerClient` implements the PrivValidator interface
+inside the node. The consensus state machine signs synchronously, so the
+client keeps a blocking socket guarded by a lock with a per-request
+deadline, and transparently reconnects with retries (the analog of
+RetrySignerClient, privval/retry_signer_client.go).
+
+Wire: 4-byte BE length + protoenc body.
+  1 PubKeyRequest    {}                    → 2 PubKeyResponse {pub_key}
+  3 SignVoteRequest  {chain_id, vote}      → 4 SignedVoteResponse {vote | err}
+  5 SignProposalReq  {chain_id, proposal}  → 6 SignedProposalResponse {…}
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+import struct
+import threading
+import time
+
+from .crypto import ed25519
+from .libs import protoenc as pe
+from .privval import DoubleSignError, PrivValidator
+from .types.vote import Proposal, Vote
+
+_LEN = struct.Struct(">I")
+
+T_PUBKEY_REQ = 1
+T_PUBKEY_RES = 2
+T_SIGN_VOTE_REQ = 3
+T_SIGN_VOTE_RES = 4
+T_SIGN_PROPOSAL_REQ = 5
+T_SIGN_PROPOSAL_RES = 6
+
+
+def _encode(tag: int, body: bytes) -> bytes:
+    payload = pe.message_field(tag, body)
+    return _LEN.pack(len(payload)) + payload
+
+
+def _decode(payload: bytes) -> tuple[int, bytes]:
+    r = pe.Reader(payload)
+    tag, _wt = r.read_tag()
+    return tag, r.read_bytes()
+
+
+class RemoteSignerError(RuntimeError):
+    pass
+
+
+class SignerServer:
+    """Serves a PrivValidator over TCP (reference
+    privval/signer_server.go / signer_dialer_endpoint)."""
+
+    def __init__(self, pv: PrivValidator, *, logger: logging.Logger | None = None):
+        self.pv = pv
+        self.logger = logger or logging.getLogger("signer.server")
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._server = await asyncio.start_server(self._serve, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            for w in list(self._writers):
+                try:
+                    w.close()
+                except Exception:
+                    pass
+            await self._server.wait_closed()
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        self._writers.add(writer)
+        try:
+            while True:
+                hdr = await reader.readexactly(_LEN.size)
+                (n,) = _LEN.unpack(hdr)
+                tag, body = _decode(await reader.readexactly(n))
+                writer.write(self._handle(tag, body))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    def _handle(self, tag: int, body: bytes) -> bytes:
+        if tag == T_PUBKEY_REQ:
+            return _encode(
+                T_PUBKEY_RES, pe.bytes_field(1, self.pv.get_pub_key().bytes())
+            )
+        if tag in (T_SIGN_VOTE_REQ, T_SIGN_PROPOSAL_REQ):
+            r = pe.Reader(body)
+            chain_id, raw = "", b""
+            while not r.eof():
+                f, wt = r.read_tag()
+                if f == 1:
+                    chain_id = r.read_string()
+                elif f == 2:
+                    raw = r.read_bytes()
+                else:
+                    r.skip(wt)
+            try:
+                if tag == T_SIGN_VOTE_REQ:
+                    signed = self.pv.sign_vote(chain_id, Vote.decode(raw))
+                    return _encode(T_SIGN_VOTE_RES, pe.bytes_field(1, signed.encode()))
+                signed = self.pv.sign_proposal(chain_id, Proposal.decode(raw))
+                return _encode(T_SIGN_PROPOSAL_RES, pe.bytes_field(1, signed.encode()))
+            except DoubleSignError as e:
+                res_tag = T_SIGN_VOTE_RES if tag == T_SIGN_VOTE_REQ else T_SIGN_PROPOSAL_RES
+                return _encode(res_tag, pe.string_field(2, str(e)))
+        return _encode(tag + 1, pe.string_field(2, f"unknown request {tag}"))
+
+
+class ThreadedSignerServer:
+    """Run a SignerServer on its own thread + event loop. The production
+    deployment is a separate process; in-process embedding (tests, the
+    CLI's one-machine mode) must NOT share the node's loop because the
+    consensus-side SignerClient blocks its calling thread while waiting
+    for the signature (matching the reference's synchronous signing
+    path)."""
+
+    def __init__(self, pv: PrivValidator):
+        self.pv = pv
+        self.port: int | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._server: SignerServer | None = None
+        self._thread: threading.Thread | None = None
+        self._ready = threading.Event()
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self._run, daemon=True, name="signer")
+        self._thread.start()
+        if not self._ready.wait(timeout=10):
+            raise RemoteSignerError("signer server failed to start")
+        return self.port
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+
+        async def main():
+            self._server = SignerServer(self.pv)
+            await self._server.start()
+            self.port = self._server.port
+            self._ready.set()
+            await asyncio.Event().wait()  # run until loop is stopped
+
+        try:
+            self._loop.run_until_complete(main())
+        except RuntimeError:
+            pass  # loop stopped
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class SignerClient(PrivValidator):
+    """PrivValidator backed by a remote signer (reference
+    signer_client.go:17 with retry_signer_client.go semantics)."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        *,
+        timeout: float = 3.0,
+        retries: int = 3,
+        logger: logging.Logger | None = None,
+    ):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.retries = retries
+        self.logger = logger or logging.getLogger("signer.client")
+        self._sock: socket.socket | None = None
+        self._lock = threading.Lock()
+        self._pub_key: ed25519.Ed25519PubKey | None = None
+
+    # -- transport -------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        s = socket.create_connection((self.host, self.port), timeout=self.timeout)
+        s.settimeout(self.timeout)
+        self._sock = s
+        return s
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _roundtrip(self, tag: int, body: bytes) -> tuple[int, bytes]:
+        last: Exception | None = None
+        for attempt in range(self.retries):
+            try:
+                with self._lock:
+                    s = self._connect()
+                    s.sendall(_encode(tag, body))
+                    hdr = self._recv_exact(s, _LEN.size)
+                    (n,) = _LEN.unpack(hdr)
+                    return _decode(self._recv_exact(s, n))
+            except (OSError, ConnectionError) as e:
+                last = e
+                self._drop()
+                time.sleep(min(0.1 * (2**attempt), 1.0))
+        raise RemoteSignerError(f"remote signer unreachable: {last!r}")
+
+    def _recv_exact(self, s: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("signer closed connection")
+            buf += chunk
+        return buf
+
+    @staticmethod
+    def _parse_signed(body: bytes) -> tuple[bytes, str]:
+        r = pe.Reader(body)
+        raw, err = b"", ""
+        while not r.eof():
+            f, wt = r.read_tag()
+            if f == 1:
+                raw = r.read_bytes()
+            elif f == 2:
+                err = r.read_string()
+            else:
+                r.skip(wt)
+        return raw, err
+
+    # -- PrivValidator ---------------------------------------------------
+
+    def get_pub_key(self):
+        if self._pub_key is None:
+            tag, body = self._roundtrip(T_PUBKEY_REQ, b"")
+            raw, _err = self._parse_signed(body)
+            self._pub_key = ed25519.Ed25519PubKey(raw)
+        return self._pub_key
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        body = pe.string_field(1, chain_id) + pe.bytes_field(2, vote.encode())
+        _tag, res = self._roundtrip(T_SIGN_VOTE_REQ, body)
+        raw, err = self._parse_signed(res)
+        if err:
+            raise DoubleSignError(err)
+        return Vote.decode(raw)
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        body = pe.string_field(1, chain_id) + pe.bytes_field(2, proposal.encode())
+        _tag, res = self._roundtrip(T_SIGN_PROPOSAL_REQ, body)
+        raw, err = self._parse_signed(res)
+        if err:
+            raise DoubleSignError(err)
+        return Proposal.decode(raw)

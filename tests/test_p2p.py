@@ -243,3 +243,45 @@ class TestRouterNetwork:
                 await asyncio.sleep(0.05)
         finally:
             await net.stop()
+
+
+class TestPex:
+    @pytest.mark.asyncio
+    async def test_address_discovery(self):
+        """Node C knows only A; PEX teaches it B's address and the mesh
+        completes (reference pex/reactor_test.go flavor), using full
+        nodes so the pex reactor is wired."""
+        from tests.test_node import NodeNet
+        from tendermint_tpu.p2p import pex as pexmod
+
+        orig = pexmod.REQUEST_INTERVAL
+        pexmod.REQUEST_INTERVAL = 0.2
+        try:
+            net = NodeNet(3)
+            await net.start(connect=False)
+            a, b, c = net.nodes
+            # A knows B; C knows only A
+            a.peer_manager.add_address(
+                NodeAddress(node_id=b.node_id, protocol="memory")
+            )
+            c.peer_manager.add_address(
+                NodeAddress(node_id=a.node_id, protocol="memory")
+            )
+            deadline = asyncio.get_running_loop().time() + 20
+            want = {a.node_id, b.node_id}
+            while set(c.peer_manager.connected_peers()) != want:
+                assert asyncio.get_running_loop().time() < deadline, (
+                    f"pex discovery incomplete: {c.peer_manager.connected_peers()}"
+                )
+                await asyncio.sleep(0.1)
+        finally:
+            pexmod.REQUEST_INTERVAL = orig
+            await net.stop()
+
+    def test_pex_codec(self):
+        from tendermint_tpu.p2p import pex as pexmod
+
+        req = pexmod.PexRequest()
+        assert pexmod.decode_message(pexmod.encode_message(req)) == req
+        res = pexmod.PexResponse(("memory:aabb", "tcp://cc@1.2.3.4:5"))
+        assert pexmod.decode_message(pexmod.encode_message(res)) == res

@@ -1,0 +1,159 @@
+"""Process-boundary tests: out-of-process ABCI over sockets, and the
+remote signer (modeled on reference abci/client/socket_client_test.go
+and privval/signer_client_test.go)."""
+
+import asyncio
+import os
+import tempfile
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.kvstore import KVStoreApp
+from tendermint_tpu.abci.socket import ABCIServer, SocketClient
+from tendermint_tpu.privval import FilePV, MockPV, DoubleSignError
+from tendermint_tpu.privval_remote import SignerClient, SignerServer
+from tendermint_tpu.testing import make_block_id
+from tendermint_tpu.types.keys import SignedMsgType
+from tendermint_tpu.types.vote import Vote
+
+
+class TestABCISocket:
+    @pytest.mark.asyncio
+    async def test_full_roundtrip(self):
+        app = KVStoreApp()
+        server = ABCIServer(app)
+        await server.start()
+        client = SocketClient("127.0.0.1", server.port)
+        await client.start()
+        try:
+            assert await client.echo("hi") == "hi"
+            info = await client.info(abci.RequestInfo())
+            assert info.last_block_height == 0
+            res = await client.check_tx(abci.RequestCheckTx(b"a=b"))
+            assert res.is_ok()
+            await client.init_chain(
+                abci.RequestInitChain(0, "c", None, (), b"{}", 1)
+            )
+            # a block cycle over the socket
+            from tendermint_tpu.types.block import Header
+
+            await client.begin_block(
+                abci.RequestBeginBlock(
+                    hash=b"\x01" * 32,
+                    header=Header(chain_id="c", height=1),
+                    last_commit_info=abci.LastCommitInfo(0),
+                )
+            )
+            dres = await client.deliver_tx(abci.RequestDeliverTx(b"a=b"))
+            assert dres.is_ok()
+            await client.end_block(abci.RequestEndBlock(1))
+            cres = await client.commit()
+            assert cres.data  # app hash
+            q = await client.query(abci.RequestQuery(data=b"a"))
+            assert q.value == b"b"
+        finally:
+            await client.stop()
+            await server.stop()
+
+    @pytest.mark.asyncio
+    async def test_pipelining(self):
+        """Many concurrent requests on one connection resolve correctly
+        and in order."""
+        app = KVStoreApp()
+        server = ABCIServer(app)
+        await server.start()
+        client = SocketClient("127.0.0.1", server.port)
+        await client.start()
+        try:
+            results = await asyncio.gather(
+                *(client.check_tx(abci.RequestCheckTx(b"k%d=v" % i)) for i in range(50))
+            )
+            assert all(r.is_ok() for r in results)
+        finally:
+            await client.stop()
+            await server.stop()
+
+    @pytest.mark.asyncio
+    async def test_node_runs_against_socket_app(self):
+        """A consensus node driven entirely through the ABCI socket."""
+        from tendermint_tpu.consensus.harness import Node as HNode, make_genesis
+        from tendermint_tpu.proxy import AppConns
+
+        app = KVStoreApp()
+        server = ABCIServer(app)
+        await server.start()
+        genesis, keys = make_genesis(1)
+        node = HNode(genesis, keys[0])
+
+        def factory(name: str):
+            return SocketClient("127.0.0.1", server.port)
+
+        node.app_conns = AppConns.from_factory(factory)
+        await node.app_conns.start()
+        await node.start()
+        try:
+            await node.cs.wait_for_height(2, timeout=30)
+            assert app.height >= 2
+        finally:
+            await node.stop()
+            await server.stop()
+
+
+class TestRemoteSigner:
+    @pytest.mark.asyncio
+    async def test_sign_via_socket(self):
+        with tempfile.TemporaryDirectory() as tmp:
+            pv = FilePV.generate(
+                os.path.join(tmp, "k.json"), os.path.join(tmp, "s.json")
+            )
+            server = SignerServer(pv)
+            await server.start()
+            client = SignerClient("127.0.0.1", server.port)
+
+            def sync_part():
+                pub = client.get_pub_key()
+                assert pub.bytes() == pv.get_pub_key().bytes()
+                vote = Vote(
+                    type=SignedMsgType.PREVOTE,
+                    height=3,
+                    round=0,
+                    block_id=make_block_id(b"x"),
+                    timestamp_ns=1_700_000_000_000_000_000,
+                    validator_address=pub.address(),
+                    validator_index=0,
+                )
+                signed = client.sign_vote("chain", vote)
+                assert pub.verify_signature(vote.sign_bytes("chain"), signed.signature)
+                # double-sign guard propagates over the wire
+                conflicting = Vote(
+                    **{**vote.__dict__, "block_id": make_block_id(b"y")}
+                )
+                try:
+                    client.sign_vote("chain", conflicting)
+                    assert False, "expected DoubleSignError"
+                except DoubleSignError:
+                    pass
+
+            await asyncio.to_thread(sync_part)
+            await server.stop()
+
+    @pytest.mark.asyncio
+    async def test_consensus_with_remote_signer(self):
+        """A validator whose key lives behind the signer socket. The
+        server runs on its own thread loop — the consensus-side client
+        blocks while signing, exactly like a separate signer process."""
+        from tendermint_tpu.consensus.harness import Node as HNode, make_genesis
+        from tendermint_tpu.privval_remote import ThreadedSignerServer
+
+        genesis, keys = make_genesis(1)
+        server = ThreadedSignerServer(MockPV(keys[0]))
+        port = server.start()
+        node = HNode(genesis, None)
+        node.priv_val = SignerClient("127.0.0.1", port)
+        await node.start()
+        try:
+            await node.cs.wait_for_height(2, timeout=30)
+        finally:
+            await node.stop()
+            server.stop()
