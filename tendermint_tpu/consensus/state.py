@@ -47,10 +47,11 @@ from ..types.keys import SignedMsgType
 from ..types.part_set import Part, PartSet
 from ..types.vote import Proposal, Vote
 from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
+from ..libs import fail
 from . import messages as m
 from .ticker import TimeoutInfo, TimeoutTicker
 from .types import HeightVoteSet, RoundState, RoundStep
-from .wal import WAL, KIND_MESSAGE
+from .wal import WAL, KIND_END_HEIGHT, KIND_MESSAGE
 
 
 def _now_ns() -> int:
@@ -796,13 +797,18 @@ class ConsensusState(Service):
             return  # still waiting for the block
         self.block_exec.validate_block(self.state, block)
 
+        # crash matrix points 1-3 mirror the reference's fail.Fail sites
+        # around finalizeCommit (state.go:1647-1712)
+        fail.fail_point(1)  # before saving the block
         if self.block_store.height() < height:
             seen_commit = precommits.make_commit()
             self.block_store.save_block(block, parts, seen_commit)
+        fail.fail_point(2)  # block saved, before the WAL end-height marker
         # height is durably decided: WAL end-height marker (the blockstore
         # has the block; replay resumes from the next height)
         if self.wal is not None and not self._replay_mode:
             self.wal.write_end_height(height)
+        fail.fail_point(3)  # marker written, before ApplyBlock
 
         state, _ = await self.block_exec.apply_block(self.state, block_id, block)
 

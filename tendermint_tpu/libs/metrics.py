@@ -1,0 +1,181 @@
+"""Metrics registry with Prometheus text exposition (the analog of the
+reference's go-kit/prometheus metrics — one Metrics struct per subsystem
+with a nop fallback, reference internal/consensus/metrics.go:19 etc.).
+
+Counters, gauges, and histograms are process-local and lock-free (the
+event loop serializes updates); `render()` emits the text format that
+Prometheus scrapes, served by the node's /metrics endpoint."""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+
+class Counter:
+    __slots__ = ("name", "help", "_values")
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = defaultdict(float)
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        self._values[tuple(sorted(labels.items()))] += value
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for labels, v in self._values.items():
+            out.append(f"{self.name}{_fmt_labels(labels)} {_fmt(v)}")
+        if not self._values:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Gauge:
+    __slots__ = ("name", "help", "_values")
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: dict[tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        self._values[tuple(sorted(labels.items()))] = value
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for labels, v in self._values.items():
+            out.append(f"{self.name}{_fmt_labels(labels)} {_fmt(v)}")
+        if not self._values:
+            out.append(f"{self.name} 0")
+        return out
+
+
+class Histogram:
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count")
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+    def __init__(self, name: str, help_: str = "", buckets=None):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        self._sum += value
+        self._count += 1
+        for i, b in enumerate(self.buckets):
+            if value <= b:
+                self._counts[i] += 1
+                return
+        self._counts[-1] += 1
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+        cum += self._counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {_fmt(self._sum)}")
+        out.append(f"{self.name}_count {self._count}")
+        return out
+
+
+def _fmt(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+
+def _fmt_labels(labels: tuple) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+class Registry:
+    def __init__(self, namespace: str = "tendermint_tpu"):
+        self.namespace = namespace
+        self._metrics: list = []
+
+    def counter(self, subsystem: str, name: str, help_: str = "") -> Counter:
+        m = Counter(f"{self.namespace}_{subsystem}_{name}", help_)
+        self._metrics.append(m)
+        return m
+
+    def gauge(self, subsystem: str, name: str, help_: str = "") -> Gauge:
+        m = Gauge(f"{self.namespace}_{subsystem}_{name}", help_)
+        self._metrics.append(m)
+        return m
+
+    def histogram(self, subsystem: str, name: str, help_: str = "", buckets=None) -> Histogram:
+        m = Histogram(f"{self.namespace}_{subsystem}_{name}", help_, buckets)
+        self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+class NodeMetrics:
+    """Per-subsystem metric sets (reference */metrics.go)."""
+
+    def __init__(self, registry: Registry | None = None):
+        r = self.registry = registry or Registry()
+        # consensus (reference internal/consensus/metrics.go:19-60)
+        self.consensus_height = r.gauge("consensus", "height", "current height")
+        self.consensus_rounds = r.gauge("consensus", "rounds", "round of the current height")
+        self.consensus_validators = r.gauge("consensus", "validators", "validator-set size")
+        self.consensus_block_interval = r.histogram(
+            "consensus", "block_interval_seconds", "time between blocks",
+            buckets=(0.1, 0.25, 0.5, 1, 2, 5, 10, 30),
+        )
+        self.consensus_txs = r.gauge("consensus", "num_txs", "txs in the last block")
+        self.consensus_byzantine = r.counter(
+            "consensus", "byzantine_validators", "equivocations seen"
+        )
+        # mempool
+        self.mempool_size = r.gauge("mempool", "size", "resident txs")
+        self.mempool_failed = r.counter("mempool", "failed_txs", "rejected txs")
+        # p2p
+        self.p2p_peers = r.gauge("p2p", "peers", "connected peers")
+        self.p2p_msg_recv = r.counter("p2p", "message_receive_bytes_total", "inbound bytes")
+        self.p2p_msg_send = r.counter("p2p", "message_send_bytes_total", "outbound bytes")
+        # blocksync
+        self.blocksync_applied = r.counter("blocksync", "blocks_applied", "blocks applied")
+        self.blocksync_sigs = r.counter(
+            "blocksync", "sigs_verified", "signatures batch-verified"
+        )
+        # abci
+        self.abci_latency = r.histogram(
+            "abci", "connection_latency_seconds", "app call latency"
+        )
+
+    def render(self) -> str:
+        return self.registry.render()
+
+
+class _LastBlock:
+    time: float | None = None
+
+
+def observe_block(metrics: NodeMetrics, block, rs=None) -> None:
+    """Update consensus metrics on a committed block."""
+    metrics.consensus_height.set(block.header.height)
+    metrics.consensus_txs.set(len(block.txs))
+    now = time.monotonic()
+    if _LastBlock.time is not None:
+        metrics.consensus_block_interval.observe(now - _LastBlock.time)
+    _LastBlock.time = now
+    if rs is not None:
+        metrics.consensus_rounds.set(rs.round)
+        if rs.validators is not None:
+            metrics.consensus_validators.set(len(rs.validators))

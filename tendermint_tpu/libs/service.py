@@ -45,7 +45,7 @@ class Service:
         for t in self._tasks:
             try:
                 await t
-            except (asyncio.CancelledError, Exception):
+            except BaseException:  # noqa: B036 — reaping; outcomes are logged elsewhere
                 pass
         self._tasks.clear()
         self._stopped.set()

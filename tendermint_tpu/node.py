@@ -267,6 +267,35 @@ class Node(Service):
             self.peer_manager.subscribe(),
         )
 
+        from .libs.metrics import NodeMetrics, observe_block
+        from .types.events import query_for_event
+
+        self.metrics = NodeMetrics()
+        blk_sub = self.event_bus.subscribe(
+            "metrics", query_for_event("NewBlock"), buffer=64
+        )
+
+        async def _metrics_loop():
+            while True:
+                try:
+                    msg = await blk_sub.next()
+                    observe_block(
+                        self.metrics,
+                        msg.data.block,
+                        self.consensus.rs if self.consensus else None,
+                    )
+                    self.metrics.p2p_peers.set(self.peer_manager.num_connected())
+                    if self.mempool is not None:
+                        self.metrics.mempool_size.set(self.mempool.size())
+                    if self.blocksync_reactor is not None:
+                        m = self.blocksync_reactor.metrics
+                        self.metrics.blocksync_applied._values[()] = m["blocks_applied"]
+                        self.metrics.blocksync_sigs._values[()] = m["sigs_verified"]
+                except Exception:
+                    pass
+
+        self.spawn(_metrics_loop(), name="node.metrics")
+
         if self.config.tx_index:
             from .state.indexer import IndexerService, KVSink
 
@@ -301,6 +330,7 @@ class Node(Service):
                 sink=self.sink,
                 peer_manager=self.peer_manager,
                 node_info=self.node_info,
+                metrics=self.metrics,
             )
             self.rpc_server = RPCServer(env)
             host, _, port = self.config.rpc_laddr.rpartition(":")

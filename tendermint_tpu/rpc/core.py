@@ -123,6 +123,7 @@ class Environment:
     sink: KVSink | None = None
     peer_manager: Any = None
     node_info: Any = None
+    metrics: Any = None  # NodeMetrics, rendered by /metrics
     logger: logging.Logger = field(default_factory=lambda: logging.getLogger("rpc"))
 
     # ------------------------------------------------------------------

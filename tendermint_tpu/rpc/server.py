@@ -50,6 +50,7 @@ class RPCServer:
         self.app = web.Application()
         self.app.router.add_post("/", self._handle_jsonrpc)
         self.app.router.add_get("/websocket", self._handle_ws)
+        self.app.router.add_get("/metrics", self._handle_metrics)
         for name in ROUTES:
             self.app.router.add_get(f"/{name}", self._make_uri_handler(name))
         self._runner: web.AppRunner | None = None
@@ -69,6 +70,14 @@ class RPCServer:
     async def stop(self) -> None:
         if self._runner is not None:
             await self._runner.cleanup()
+
+    async def _handle_metrics(self, request: web.Request) -> web.Response:
+        metrics = getattr(self.env, "metrics", None)
+        if metrics is None:
+            return web.Response(status=404, text="metrics disabled\n")
+        return web.Response(
+            text=metrics.render(), content_type="text/plain", charset="utf-8"
+        )
 
     # -- dispatch --------------------------------------------------------
 

@@ -10,6 +10,7 @@ import logging
 
 from .. import crypto
 from ..abci import types as abci
+from ..libs import fail
 from ..abci.client import Client
 from ..evidence import EvidencePoolI, NopEvidencePool
 from ..mempool import Mempool, NopMempool
@@ -157,7 +158,10 @@ class BlockExecutor:
         self.validate_block(state, block)
 
         responses = await self._exec_block(state, block)
+        # crash points 4-5 mirror execution.go:170-217's fail.Fail sites
+        fail.fail_point(4)  # block executed, before persisting responses
         self.state_store.save_abci_responses(block.header.height, responses)
+        fail.fail_point(5)  # responses saved, before app Commit
 
         # validator + params updates requested by the app
         val_updates = validator_updates_to_validators(

