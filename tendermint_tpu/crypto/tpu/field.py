@@ -2,21 +2,47 @@
 
 Representation: a field element is an int32 array of shape (..., 32), limb i
 holding (partially reduced) coefficient of 256^i, all limbs non-negative.
-The invariant maintained between operations is limbs < 2^10, which keeps the
-schoolbook product fold below 2^31:
 
-    conv ≤ 32·(2^10)² = 2^25,  fold ≤ (1+38)·2^25 < 2^31.
+The invariant maintained between operations is limbs < 2^9 = 512 (`mul`,
+`sub`, `neg`, `mul_scalar` return limbs ≤ 293; `add` returns ≤ 369; `mul`
+accepts anything < 2^9). That bound is what makes
+the MXU formulation of the product exact: the 32×32 outer product has entries
+≤ 511² < 2^18 (exact in float32), and the anti-diagonal contraction sums at
+most 32 of them, so every partial sum is an integer < 2^23 < 2^24 and float32
+GEMM accumulation is bit-exact.
 
-`mul` returns limbs < 2^9 (three vectorized carry passes); `add` may be fed
-straight into `mul` once; `sub` carries once and returns limbs < 2^10.
+`mul` computes the schoolbook convolution as
+
+    outer = a ⊗ b                  (..., 32, 32)  — VPU elementwise
+    conv  = outer.reshape(..., 1024) @ S           — MXU GEMM, S constant 0/1
+                                                     with S[i·32+j, i+j] = 1
+
+then folds 2^256 ≡ 38 and runs four vectorized carry passes in int32. This
+is ~10 HLO ops per multiply (vs ~100 for an unrolled pad+add convolution),
+which keeps XLA compile time of the 256-step verification scan in seconds,
+and it routes the bulk of the MAC work onto the systolic array.
+
+Carry-pass bound analysis (why four passes suffice): a pass keeps the low
+byte (≤255) and adds the neighbour's carry; only limb 0 takes a ×38 carry
+(from limb 31). Carries move one position per pass, so bounds are
+positional — limbs 1..3 inherit limb 0's 38×-inflated carry with a lag.
+From a uniform fold bound ≤ 39·2^23 < 2^28.3:
+  pass 1: limb0 ≤ 2^25.6, limbs 1-31 ≤ 2^20.3
+  pass 2: limb0 ≤ 2^17.9, limb1 ≤ 2^17.6 (limb 0's pass-1 carry),
+          limbs 2-31 ≤ 5400
+  pass 3: limb0 ≤ 1053, limb1 ≤ 1215, limb2 ≤ 1031, limbs 3-31 ≤ 276
+  pass 4: limb0 ≤ 293, limbs 1-3 ≤ 259, limbs 4-31 ≤ 256
+so every limb ends ≤ 293 < 2^9. (Three passes would NOT suffice: limbs
+0-2 can still exceed 2^9 after pass 3.)
+
 Canonicalization (exact byte form, for parity/equality/compression) uses a
 `lax.scan` along the limb axis — sequential in the 32 limbs, vectorized over
 the batch.
 
 Why radix 2^8 / int32 and not wider limbs: TPUs have no native 64-bit
-integer path (s64 is emulated), while int32 multiply-add runs on the VPU at
+integer path (s64 is emulated), while int32 carry logic runs on the VPU at
 full lane rate; 8-bit limbs also make byte-level I/O (keys, signatures) a
-zero-cost reinterpretation.
+zero-cost reinterpretation, and keep the f32 GEMM exact (see above).
 """
 
 from __future__ import annotations
@@ -52,7 +78,7 @@ D2_LIMBS = int_to_limbs(2 * D_INT)
 SQRT_M1_LIMBS = int_to_limbs(SQRT_M1_INT)
 ONE = int_to_limbs(1)
 ZERO = np.zeros(LIMBS, dtype=np.int32)
-# 8p in limb form: every limb large enough to dominate a (<2^10)-bounded
+# 8p in limb form: every limb large enough to dominate a (<2^9)-bounded
 # subtrahend, used to keep subtraction non-negative.
 EIGHT_P = (8 * P_LIMBS).astype(np.int32)
 
@@ -66,30 +92,32 @@ def _carry_pass(c: jnp.ndarray) -> jnp.ndarray:
     return low + hi_shift
 
 
-def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Field multiply. Inputs: limbs s.t. max(a)·max(b)·32·39 < 2^31.
-    Output: limbs < 2^9.
+# Anti-diagonal routing matrix: S[i*32+j, i+j] = 1. Contracting the flat
+# outer product with S computes the polynomial convolution as one GEMM.
+_S_CONV = np.zeros((LIMBS * LIMBS, 2 * LIMBS - 1), np.float32)
+for _i in range(LIMBS):
+    for _j in range(LIMBS):
+        _S_CONV[_i * LIMBS + _j, _i + _j] = 1.0
 
-    The schoolbook convolution is expressed as 32 shifted pad+add terms —
-    pure concat/add ops that XLA fuses into vector code (a scatter-based
-    formulation constant-folds catastrophically; see git history)."""
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply. Inputs: limbs < 2^9 (the module invariant).
+    Output: limbs ≤ 293 (< 2^9). See module docstring for the exactness
+    and carry-bound analysis."""
     a, b = jnp.broadcast_arrays(a, b)
-    nd = a.ndim
-    acc = None
-    for i in range(LIMBS):
-        term = jnp.pad(
-            a[..., i : i + 1] * b, [(0, 0)] * (nd - 1) + [(i, LIMBS - 1 - i)]
-        )
-        acc = term if acc is None else acc + term
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    outer = af[..., :, None] * bf[..., None, :]  # (..., 32, 32), ≤ 511² exact
+    flat = outer.reshape(outer.shape[:-2] + (LIMBS * LIMBS,))
+    # HIGHEST precision: the contraction must be true f32 (bit-exact for
+    # integers < 2^24), not a bf16 multi-pass approximation.
+    conv = jnp.matmul(
+        flat, jnp.asarray(_S_CONV), precision=jax.lax.Precision.HIGHEST
+    ).astype(jnp.int32)
     hi = jnp.pad(
-        acc[..., LIMBS:], [(0, 0)] * (nd - 1) + [(0, 1)], constant_values=0
+        conv[..., LIMBS:], [(0, 0)] * (a.ndim - 1) + [(0, 1)], constant_values=0
     )
-    c = acc[..., :LIMBS] + 38 * hi
-    # four passes: the ×38 fold re-injects into limb 0 each pass, so three
-    # passes only bound limbs by ~2^12 in the worst (add-fed) case; the
-    # fourth brings every limb under 2^9 with full margin for one add or
-    # sub before the next multiply. A pass is ~5 vector ops — noise next
-    # to the 1024-MAC convolution.
+    c = conv[..., :LIMBS] + 38 * hi
     c = _carry_pass(_carry_pass(_carry_pass(_carry_pass(c))))
     return c
 
@@ -116,23 +144,22 @@ def mul_many(pairs: list[tuple[jnp.ndarray, jnp.ndarray]]) -> list[jnp.ndarray]:
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Limb-wise add; result may be fed to one mul, but not chained adds
-    without a carry. Use `add_c` to re-establish the <2^10 bound."""
-    return a + b
-
-
-def add_c(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a + b with one carry pass: inputs < 2^9 → output ≤ 369 (< 2^9),
+    preserving the module invariant (mul's f32 path needs inputs < 2^9)."""
     return _carry_pass(a + b)
 
 
+add_c = add
+
+
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a - b mod p, non-negative limbs via +8p, then one carry pass.
-    Output limbs < 2^10."""
-    return _carry_pass(a + jnp.asarray(EIGHT_P) - b)
+    """a - b mod p, non-negative limbs via +8p, then two carry passes.
+    Inputs < 2^9 → sum < 511+2040 < 2^12 → output ≤ 293 (< 2^9)."""
+    return _carry_pass(_carry_pass(a + jnp.asarray(EIGHT_P) - b))
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    return _carry_pass(jnp.asarray(EIGHT_P) - a)
+    return _carry_pass(_carry_pass(jnp.asarray(EIGHT_P) - a))
 
 
 def mul_scalar(a: jnp.ndarray, k: int) -> jnp.ndarray:
