@@ -38,6 +38,18 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def reexec_forced_cpu(reason: str) -> None:
+    """Replace this process with a forced-CPU rerun of the benchmark.
+    Used when a thread is wedged inside backend init or a device call —
+    that thread holds jax's global backend lock, so no in-process fallback
+    can make progress."""
+    log(f"{reason}; re-execing with forced CPU for the fallback run")
+    sys.stderr.flush()
+    sys.stdout.flush()
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TMTPU_BENCH_FORCED_CPU="1")
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+
+
 def init_backend(attempts: int = 3, timeout_s: float = 180.0) -> str:
     """Initialize a JAX backend, preferring the ambient platform (the TPU
     tunnel), with a watchdog thread per attempt. Failed (raised) inits are
@@ -76,12 +88,7 @@ def init_backend(attempts: int = 3, timeout_s: float = 180.0) -> str:
             # init is wedged inside xla_bridge.backends(), which holds
             # _backend_lock for the whole call — every other jax call in
             # this process (including a CPU fallback) would block on it.
-            log(f"backend init hung past {timeout_s:.0f}s")
-            log("re-execing with forced CPU for the fallback run")
-            env = dict(os.environ, JAX_PLATFORMS="cpu", TMTPU_BENCH_FORCED_CPU="1")
-            sys.stderr.flush()
-            sys.stdout.flush()
-            os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+            reexec_forced_cpu(f"backend init hung past {timeout_s:.0f}s")
         log(f"backend init attempt {i+1}/{attempts} failed: "
             f"{result.get('error')!r}")
         if i < attempts - 1:
@@ -165,12 +172,7 @@ def main() -> None:
     if "bitmap" not in wres:
         if os.environ.get("TMTPU_BENCH_FORCED_CPU") == "1" or backend == "cpu":
             raise RuntimeError(f"warmup failed on CPU backend: {wres.get('error')!r}")
-        log(f"warmup hung/failed on {backend} ({wres.get('error')!r}); "
-            "re-execing with forced CPU")
-        sys.stderr.flush()
-        sys.stdout.flush()
-        env = dict(os.environ, JAX_PLATFORMS="cpu", TMTPU_BENCH_FORCED_CPU="1")
-        os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
+        reexec_forced_cpu(f"warmup hung/failed on {backend} ({wres.get('error')!r})")
     bitmap = wres["bitmap"]
     assert bool(np.all(bitmap)), "verification failed on valid commits"
     log(f"warmup+compile: {time.perf_counter()-t0:.1f}s")

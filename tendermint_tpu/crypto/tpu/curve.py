@@ -55,10 +55,6 @@ _BX_INT = _recover_x_int(_BY_INT, 0)
 BASE_X = F.int_to_limbs(_BX_INT)
 BASE_Y = F.int_to_limbs(_BY_INT)
 BASE_T = F.int_to_limbs(_BX_INT * _BY_INT % F.P_INT)
-# cached (Niels) form of the base point, as constants
-BASE_YMX = F.int_to_limbs(_BY_INT - _BX_INT)
-BASE_YPX = F.int_to_limbs(_BY_INT + _BX_INT)
-BASE_T2D = F.int_to_limbs(_BX_INT * _BY_INT % F.P_INT * 2 * F.D_INT)
 
 
 def base_point(batch_shape=()) -> Point:
@@ -200,56 +196,99 @@ def decompress(y_bytes: jnp.ndarray) -> tuple[Point, jnp.ndarray]:
     return Point(x, y, jnp.broadcast_to(jnp.asarray(F.ONE), y.shape), F.mul(x, y)), valid
 
 
-def cached_select(mask: jnp.ndarray, p: CachedPoint, q: CachedPoint) -> CachedPoint:
-    m = mask[..., None]
-    return CachedPoint(
-        jnp.where(m, p.ymx, q.ymx),
-        jnp.where(m, p.ypx, q.ypx),
-        jnp.where(m, p.t2d, q.t2d),
-        jnp.where(m, p.z2, q.z2),
-    )
+# -- radix-16 double-scalar multiplication ----------------------------------
+#
+# Constant 16-entry table of j·B (j = 0..15) in affine Niels form
+# (y-x, y+x, 2d·x·y; z2 = 2), computed on the host with exact integers.
+# In an MSB-first radix-16 Horner scan  Q ← 16·Q + X_d,  a term X added
+# while digit d remains to be processed is multiplied by 16^d by the
+# remaining quadruplings — so the SAME affine table serves every step;
+# no per-step comb table is needed.
+
+
+def _affine_niels_int(x: int, y: int) -> tuple[int, int, int]:
+    p = F.P_INT
+    return ((y - x) % p, (y + x) % p, 2 * F.D_INT * x % p * y % p)
+
+
+def _build_base_table() -> np.ndarray:
+    from ..ed25519_math import Point as IntPoint
+
+    b = IntPoint.from_affine(_BX_INT, _BY_INT)
+    rows = []
+    for j in range(16):
+        pj = b.scalar_mul(j)
+        zinv = pow(pj.Z, F.P_INT - 2, F.P_INT)
+        x, y = pj.X * zinv % F.P_INT, pj.Y * zinv % F.P_INT
+        rows.append([F.int_to_limbs(v) for v in _affine_niels_int(x, y)])
+    return np.stack(rows).astype(np.int32)  # (16, 3, 32)
+
+
+_BASE_TABLE = _build_base_table()
+_TWO = F.int_to_limbs(2)
+
+
+def _mul_table(a_neg: Point) -> list[CachedPoint]:
+    """[j·A' for j in 0..15] in cached form (A' = -A), 7 doubles + 7 adds."""
+    batch_shape = a_neg.x.shape[:-1]
+    an_cached = to_cached(a_neg)
+    exts: list[Point] = [identity(batch_shape), a_neg]
+    for j in range(2, 16):
+        if j % 2 == 0:
+            exts.append(point_double(exts[j // 2]))
+        else:
+            exts.append(add_cached(exts[j - 1], an_cached))
+    cached = [cached_identity(batch_shape), an_cached]
+    cached += [to_cached(p) for p in exts[2:]]
+    return cached
 
 
 def scalar_mul_double(
-    s_bits: jnp.ndarray, h_bits: jnp.ndarray, a_neg: Point
+    s_digits: jnp.ndarray, h_digits: jnp.ndarray, a_neg: Point
 ) -> Point:
     """Joint double-scalar multiplication: returns s·B + h·(-A), batched.
 
-    s_bits, h_bits: (..., 256) int32 in {0,1}, little-endian bit order.
-    One 256-iteration lax.scan (MSB first): Q = 2Q; Q += table[bits], where
-    table = [Id, B, -A, B-A] is precomputed in cached (Niels) form and
-    selected branchlessly per batch element.
+    s_digits, h_digits: (..., 64) int32 in [0, 16), little-endian radix-16
+    digits. One 64-iteration lax.scan (MSB digit first), each step doing
+    four doublings and two cached additions with branchless 16-way table
+    lookups: the constant affine j·B table and a per-batch j·(-A) table
+    built with 7 doubles + 7 adds before the scan. vs the bit-serial
+    ladder (256 doubles + 256 adds) this does 256 doubles + 128 adds and
+    a scan a quarter as long.
     """
     import jax
 
-    batch_shape = s_bits.shape[:-1]
+    batch_shape = s_digits.shape[:-1]
     idp = identity(batch_shape)
 
-    def bc(arr):
-        return jnp.broadcast_to(jnp.asarray(arr), batch_shape + (F.LIMBS,))
-
-    b_cached = CachedPoint(
-        bc(BASE_YMX), bc(BASE_YPX), bc(BASE_T2D), bc(F.int_to_limbs(2))
+    ta = _mul_table(a_neg)
+    # stack the 16 entries on a leading axis per component: (16, ..., 32)
+    ta_arrs = tuple(
+        jnp.stack([getattr(c, comp) for c in ta])
+        for comp in ("ymx", "ypx", "t2d", "z2")
     )
-    an_cached = to_cached(a_neg)
-    ban_cached = to_cached(add_cached(base_point(batch_shape), an_cached))
-    id_cached = cached_identity(batch_shape)
+    tb = jnp.asarray(_BASE_TABLE)  # (16, 3, 32) constant
+    two = jnp.broadcast_to(jnp.asarray(_TWO), batch_shape + (F.LIMBS,))
 
-    # scan over bits MSB->LSB: move bit axis to front, reversed
-    sb = jnp.moveaxis(s_bits[..., ::-1], -1, 0)  # (256, ...)
-    hb = jnp.moveaxis(h_bits[..., ::-1], -1, 0)
+    def gather_ta(d: jnp.ndarray) -> CachedPoint:
+        idx = jnp.broadcast_to(d[None, ..., None], (1,) + batch_shape + (F.LIMBS,))
+        parts = [jnp.take_along_axis(arr, idx, axis=0)[0] for arr in ta_arrs]
+        return CachedPoint(*parts)
 
-    def step(q: Point, bits):
-        sbit, hbit = bits
-        q = point_double(q)
-        sel_s = sbit.astype(bool)
-        sel_h = hbit.astype(bool)
-        t = cached_select(
-            sel_s,
-            cached_select(sel_h, ban_cached, b_cached),
-            cached_select(sel_h, an_cached, id_cached),
-        )
-        return add_cached(q, t), None
+    def gather_tb(d: jnp.ndarray) -> CachedPoint:
+        e = jnp.take(tb, d, axis=0)  # (..., 3, 32)
+        return CachedPoint(e[..., 0, :], e[..., 1, :], e[..., 2, :], two)
 
-    q, _ = jax.lax.scan(step, idp, (sb, hb))
+    # scan over digits MSB->LSB: move digit axis to front, reversed
+    sd = jnp.moveaxis(s_digits[..., ::-1], -1, 0)  # (64, ...)
+    hd = jnp.moveaxis(h_digits[..., ::-1], -1, 0)
+
+    def step(q: Point, digits):
+        s_d, h_d = digits
+        q = point_double(point_double(point_double(point_double(q))))
+        q = add_cached(q, gather_ta(h_d))
+        q = add_cached(q, gather_tb(s_d))
+        return q, None
+
+    q, _ = jax.lax.scan(step, idp, (sd, hd))
     return q

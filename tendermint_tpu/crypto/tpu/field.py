@@ -19,8 +19,8 @@ GEMM accumulation is bit-exact.
 
 then folds 2^256 ≡ 38 and runs four vectorized carry passes in int32. This
 is ~10 HLO ops per multiply (vs ~100 for an unrolled pad+add convolution),
-which keeps XLA compile time of the 256-step verification scan in seconds,
-and it routes the bulk of the MAC work onto the systolic array.
+which keeps XLA compile time of the verification scan in seconds, and it
+routes the bulk of the MAC work onto the systolic array.
 
 Carry-pass bound analysis (why four passes suffice): a pass keeps the low
 byte (≤255) and adds the neighbour's carry; only limb 0 takes a ×38 carry
