@@ -1,8 +1,13 @@
 """Evidence of Byzantine behavior (reference types/evidence.go).
 
-Round 1 implements DuplicateVoteEvidence (equivocation — two different
-votes for the same height/round/type from one validator). Light-client
-attack evidence lands with the light-client detector."""
+DuplicateVoteEvidence: equivocation — two different votes for the same
+height/round/type from one validator.
+
+LightClientAttackEvidence (reference types/evidence.go:214): a provider
+served a light client a conflicting, properly-signed header. The evidence
+carries the whole conflicting light block, the last height at which the
+attacked client and the attacker agreed (common height), and the
+validators the attack can be attributed to."""
 
 from __future__ import annotations
 
@@ -10,7 +15,7 @@ from dataclasses import dataclass
 
 from ..crypto.hashes import sha256
 from ..libs import protoenc as pe
-from .validator_set import ValidatorSet
+from .validator_set import Validator, ValidatorSet
 from .vote import Vote
 
 EVIDENCE_DUPLICATE_VOTE = 1
@@ -98,6 +103,138 @@ class DuplicateVoteEvidence:
             raise ValueError("votes not in deterministic order")
 
 
+@dataclass(frozen=True)
+class LightClientAttackEvidence:
+    """Reference types/evidence.go:214. `conflicting_block` is the forged
+    (but properly signed) light block; `common_height` the last height the
+    divergent chains agreed at; `byzantine_validators` the validators the
+    attack is attributable to (empty for amnesia attacks)."""
+
+    conflicting_block: object  # light.types.LightBlock (lazy to avoid cycle)
+    common_height: int
+    byzantine_validators: tuple  # tuple[Validator, ...]
+    total_voting_power: int
+    timestamp_ns: int
+
+    TYPE = EVIDENCE_LIGHT_CLIENT_ATTACK
+
+    @property
+    def height(self) -> int:
+        # expiry is measured from the common height (evidence.go Height())
+        return self.common_height
+
+    @property
+    def conflicting_height(self) -> int:
+        return self.conflicting_block.height
+
+    def hash(self) -> bytes:
+        # header hash + common height: the same attack reported with
+        # different byzantine attributions dedupes to one entry
+        return sha256(
+            self.conflicting_block.header.hash()
+            + self.common_height.to_bytes(8, "big")
+        )
+
+    def conflicting_header_is_invalid(self, trusted_header) -> bool:
+        """Lunatic attack: the conflicting header fabricates one of the
+        fields that are deterministically derived from state (reference
+        evidence.go ConflictingHeaderIsInvalid)."""
+        h, t = self.conflicting_block.header, trusted_header
+        return not (
+            h.validators_hash == t.validators_hash
+            and h.next_validators_hash == t.next_validators_hash
+            and h.consensus_hash == t.consensus_hash
+            and h.app_hash == t.app_hash
+            and h.last_results_hash == t.last_results_hash
+        )
+
+    def get_byzantine_validators(
+        self, common_vals: ValidatorSet, trusted_signed_header
+    ) -> list[Validator]:
+        """Who to punish (reference evidence.go GetByzantineValidators):
+        lunatic → common-set validators who signed the conflicting block;
+        equivocation (same round) → validators who signed both blocks;
+        amnesia (different rounds) → unattributable, empty."""
+        conflicting_commit = self.conflicting_block.signed_header.commit
+        out: list[Validator] = []
+        if self.conflicting_header_is_invalid(trusted_signed_header.header):
+            for sig in conflicting_commit.signatures:
+                if not sig.for_block():
+                    continue
+                _, val = common_vals.get_by_address(sig.validator_address)
+                if val is not None:
+                    out.append(val)
+        elif trusted_signed_header.commit.round == conflicting_commit.round:
+            trusted_signers = {
+                s.validator_address
+                for s in trusted_signed_header.commit.signatures
+                if s.for_block()
+            }
+            for sig in conflicting_commit.signatures:
+                if not sig.for_block() or sig.validator_address not in trusted_signers:
+                    continue
+                _, val = self.conflicting_block.validators.get_by_address(
+                    sig.validator_address
+                )
+                if val is not None:
+                    out.append(val)
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        return out
+
+    def encode(self) -> bytes:
+        out = pe.varint_field(1, self.TYPE)
+        out += pe.message_field(2, self.conflicting_block.encode())
+        out += pe.varint_field(3, self.common_height)
+        for val in self.byzantine_validators:
+            out += pe.message_field(4, val.encode())
+        out += pe.varint_field(5, self.total_voting_power)
+        out += pe.message_field(6, pe.varint_field(1, self.timestamp_ns))
+        return out
+
+    @classmethod
+    def decode_fields(cls, r: pe.Reader) -> "LightClientAttackEvidence":
+        from ..light.types import LightBlock
+
+        cb = None
+        ch = tvp = ts = 0
+        byz: list[Validator] = []
+        while not r.eof():
+            f, wt = r.read_tag()
+            if f == 2:
+                cb = LightBlock.decode(r.read_bytes())
+            elif f == 3:
+                ch = r.read_uvarint()
+            elif f == 4:
+                byz.append(Validator.decode(r.read_bytes()))
+            elif f == 5:
+                tvp = r.read_uvarint()
+            elif f == 6:
+                rr = pe.Reader(r.read_bytes())
+                while not rr.eof():
+                    ff, wwt = rr.read_tag()
+                    if ff == 1:
+                        ts = rr.read_uvarint()
+                    else:
+                        rr.skip(wwt)
+            else:
+                r.skip(wt)
+        return cls(cb, ch, tuple(byz), tvp, ts)
+
+    def validate_basic(self) -> None:
+        if self.conflicting_block is None:
+            raise ValueError("missing conflicting block")
+        if self.conflicting_block.signed_header is None:
+            raise ValueError("conflicting block missing signed header")
+        if self.conflicting_block.validators is None:
+            raise ValueError("conflicting block missing validator set")
+        if self.common_height <= 0:
+            raise ValueError("non-positive common height")
+        if self.common_height > self.conflicting_block.height:
+            raise ValueError("common height beyond conflicting block height")
+        if self.total_voting_power <= 0:
+            raise ValueError("non-positive total voting power")
+
+
 def decode_evidence(data: bytes):
     r = pe.Reader(data)
     f, wt = r.read_tag()
@@ -106,6 +243,8 @@ def decode_evidence(data: bytes):
     type_ = r.read_uvarint()
     if type_ == EVIDENCE_DUPLICATE_VOTE:
         return DuplicateVoteEvidence.decode_fields(r)
+    if type_ == EVIDENCE_LIGHT_CLIENT_ATTACK:
+        return LightClientAttackEvidence.decode_fields(r)
     raise ValueError(f"unknown evidence type {type_}")
 
 
