@@ -24,11 +24,15 @@ SNAPSHOT_FORMAT = 1
 _STATE_KEY = b"__kvstore_state__"
 
 
-def _state_hash(items: dict[bytes, bytes], height: int) -> bytes:
+def _state_hash(items: dict[bytes, bytes]) -> bytes:
+    """Hash of the key-value data only — deliberately NOT height-salted:
+    an empty block must leave the app hash unchanged, or consensus's
+    needProofBlock would force a proof block after every empty block
+    (reference kvstore hashes tree size, same property)."""
     enc = json.dumps(
         {k.hex(): v.hex() for k, v in sorted(items.items())}, sort_keys=True
     ).encode()
-    return sha256(height.to_bytes(8, "big") + enc)
+    return sha256(enc)
 
 
 class KVStoreApp(BaseApplication):
@@ -166,7 +170,7 @@ class KVStoreApp(BaseApplication):
     def commit(self):
         self.items.update(self._staged)
         self._staged = {}
-        self.app_hash = _state_hash(self.items, self.height)
+        self.app_hash = _state_hash(self.items)
         self._save()
         self._take_snapshot()
         retain = 0
@@ -256,7 +260,7 @@ class KVStoreApp(BaseApplication):
         self.items = {bytes.fromhex(k): bytes.fromhex(v) for k, v in d["items"].items()}
         self.height = d["height"]
         self.validators = {bytes.fromhex(k): p for k, p in d["validators"].items()}
-        self.app_hash = _state_hash(self.items, self.height)
+        self.app_hash = _state_hash(self.items)
         self._save()
         self._restore_chunks = None
         self._restore_target = None

@@ -117,6 +117,7 @@ class Node:
             evidence_pool=self.evidence_pool,
             wal=self.wal,
             event_bus=self.event_bus,
+            mempool=self.mempool,
         )
         await self.cs.start()
 

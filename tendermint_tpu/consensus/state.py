@@ -64,6 +64,10 @@ class MsgInfo:
     peer_id: str = ""  # "" = internally generated
 
 
+# queue sentinel: mempool signalled txs-available (create_empty_blocks=false)
+_TXS_AVAILABLE = object()
+
+
 class ConsensusError(RuntimeError):
     pass
 
@@ -80,12 +84,14 @@ class ConsensusState(Service):
         evidence_pool: EvidencePoolI | None = None,
         wal: WAL | None = None,
         event_bus: EventBus | None = None,
+        mempool=None,
         logger: logging.Logger | None = None,
     ):
         super().__init__("consensus", logger)
         self.config = config
         self.block_exec = block_exec
         self.block_store = block_store
+        self.mempool = mempool
         self.priv_validator = priv_validator
         self.evidence_pool = evidence_pool or NopEvidencePool()
         self.wal = wal
@@ -114,6 +120,7 @@ class ConsensusState(Service):
         self._n_started_height = 0
         self._wake = asyncio.Event()  # new-height nudge for tests
         self._decided: asyncio.Event = asyncio.Event()
+        self._sign_jobs: list[tuple] = []  # deferred privval signing
 
         self.update_to_state(state)
 
@@ -125,6 +132,13 @@ class ConsensusState(Service):
         if self.wal is not None:
             self.catchup_replay()
         self.spawn(self._receive_routine(), name="cs.receive")
+        if not self.config.create_empty_blocks and self.mempool is not None:
+            # reference receiveRoutine's txsAvailable case (state.go:770):
+            # with create_empty_blocks=false the proposer blocks in
+            # NEW_ROUND until the mempool signals txs — without this
+            # consumer the chain stalls permanently at the first empty
+            # height when the interval is 0
+            self.spawn(self._txs_available_routine(), name="cs.txs_available")
         # kick off the first height
         self._schedule_timeout(
             self.config.timeout_commit_ns, self.rs.height, 0, RoundStep.NEW_HEIGHT
@@ -268,6 +282,7 @@ class ConsensusState(Service):
         dropped; timers are ignored."""
         self._paused = True
         self._finalize_pending = False
+        self._sign_jobs.clear()
         self.ticker.stop()
 
     def resume_with_state(self, state: State) -> None:
@@ -289,7 +304,9 @@ class ConsensusState(Service):
             if self._paused:
                 continue
             try:
-                if isinstance(item, TimeoutInfo):
+                if item is _TXS_AVAILABLE:
+                    self._handle_txs_available()
+                elif isinstance(item, TimeoutInfo):
                     self._wal_write(m.encode_wal_message(item), sync=True)
                     self._handle_timeout(item)
                 else:
@@ -307,10 +324,16 @@ class ConsensusState(Service):
                 )
             except (VoteSetError, BlockValidationError, ValueError) as e:
                 self.logger.info("dropped invalid consensus input: %r", e)
-            # run any async follow-up (finalize) scheduled by handlers;
-            # a failure here must not kill the receive loop
+            # run async follow-ups scheduled by handlers (off-loop privval
+            # signing, then finalize) until quiescent — a signed own-vote
+            # can trigger transitions that queue more signing; a failure
+            # here must not kill the receive loop
             try:
-                await self._drain_finalize()
+                while (self._sign_jobs or self._finalize_pending) and (
+                    not self._paused
+                ):
+                    await self._drain_signing()
+                    await self._drain_finalize()
             except Exception as e:
                 self.logger.error(
                     "finalize failed at height %d: %r", self.rs.height, e
@@ -331,6 +354,27 @@ class ConsensusState(Service):
             self._finalize_pending = False
             await self._finalize_commit()
 
+    def _queue_signing(self, sign_fn, on_signed, what: str) -> None:
+        """Defer a privval signing call: the blocking I/O (remote signer
+        socket + retry backoff, FilePV fsync) runs in a worker thread and
+        only the consensus task waits on it — like the reference, where
+        SignVote blocks receiveRoutine but no other goroutine."""
+        self._sign_jobs.append((sign_fn, on_signed, what))
+
+    async def _drain_signing(self) -> None:
+        while self._sign_jobs and not self._paused:
+            sign_fn, on_signed, what = self._sign_jobs.pop(0)
+            try:
+                signed = await asyncio.to_thread(sign_fn)
+            except Exception as e:
+                self.logger.error("failed signing %s: %r", what, e)
+                continue
+            if self._paused:
+                # pause() landed while the sign was in flight: block-sync
+                # owns block application now — drop the result
+                return
+            on_signed(signed)
+
     # ------------------------------------------------------------------
     # message dispatch (sync — mutations happen inline; the only async
     # part, ApplyBlock, is deferred via _finalize_pending)
@@ -346,6 +390,54 @@ class ConsensusState(Service):
             self._try_add_vote(msg.vote, mi.peer_id)
         else:
             self.logger.debug("ignoring message %s", type(msg).__name__)
+
+    async def _txs_available_routine(self) -> None:
+        """Bridge the mempool's txs-available signal into the state
+        machine's input queue (reference state.go:770 txsAvailable case).
+        Fires at most once per height: `notified_txs_available` is the
+        latch, reset by mempool.update() after each commit."""
+        while True:
+            await self.mempool.wait_for_txs()
+            if self.mempool.notified_txs_available:
+                # already fired for this height; txs still resident —
+                # sleep until the post-commit reset pulse
+                await self.mempool.wait_notified_reset()
+                continue
+            self.mempool.notified_txs_available = True
+            await self.msg_queue.put(_TXS_AVAILABLE)
+
+    def _need_proof_block(self, height: int) -> bool:
+        """Reference needProofBlock state.go:1048: the app hash produced by
+        executing height-1 only becomes part of a header at `height` — if
+        it changed, that block must be proposed even with an empty mempool,
+        or the new app state is never committed to any header."""
+        if self.state is None or height == self.state.initial_height:
+            return True
+        meta_header = self.block_store.load_block(height - 1)
+        if meta_header is None:
+            return False
+        return self.state.app_hash != meta_header.header.app_hash
+
+    def _handle_txs_available(self) -> None:
+        """Reference handleTxsAvailable state.go:919: with
+        create_empty_blocks=false the proposer idles in NEW_HEIGHT /
+        NEW_ROUND until the mempool has work; this kicks it forward."""
+        rs = self.rs
+        if self.config.create_empty_blocks:
+            return
+        if rs.step == RoundStep.NEW_HEIGHT:
+            # commit timeout still pending — arm a NEW_ROUND step timeout
+            # for its REMAINING time (state.go:927), so the block lands at
+            # the configured inter-block cadence, not tx-arrival + full
+            # commit timeout
+            self._schedule_timeout(
+                max(0, rs.start_time_ns - _now_ns()),
+                rs.height,
+                0,
+                RoundStep.NEW_ROUND,
+            )
+        elif rs.step == RoundStep.NEW_ROUND:
+            self._enter_propose(rs.height, 0)
 
     def _handle_timeout(self, ti: TimeoutInfo) -> None:
         """Reference handleTimeout state.go:907."""
@@ -432,6 +524,7 @@ class ConsensusState(Service):
         wait_for_txs = (
             not self.config.create_empty_blocks
             and round_ == 0
+            and not self._need_proof_block(height)
         )
         if wait_for_txs:
             if self.config.create_empty_blocks_interval_ns > 0:
@@ -511,18 +604,27 @@ class ConsensusState(Service):
 
         block_id = BlockID(block.hash(), parts.header)
         proposal = Proposal(height, round_, rs.valid_round, block_id, _now_ns())
-        try:
-            proposal = self.priv_validator.sign_proposal(self.state.chain_id, proposal)
-        except Exception as e:
-            self.logger.error("propose step; failed signing proposal: %r", e)
-            return
-        self._send_internal(MsgInfo(m.ProposalMessage(proposal)))
-        self._broadcast(m.ProposalMessage(proposal))
-        for i in range(parts.header.total):
-            part = parts.get_part(i)
-            self._send_internal(MsgInfo(m.BlockPartMessage(height, round_, part)))
-            self._broadcast(m.BlockPartMessage(height, round_, part))
-        self.logger.info("proposed block %d/%d %s", height, round_, block_id.hash.hex()[:12])
+
+        def on_signed(signed: Proposal) -> None:
+            self._send_internal(MsgInfo(m.ProposalMessage(signed)))
+            self._broadcast(m.ProposalMessage(signed))
+            for i in range(parts.header.total):
+                part = parts.get_part(i)
+                self._send_internal(MsgInfo(m.BlockPartMessage(height, round_, part)))
+                self._broadcast(m.BlockPartMessage(height, round_, part))
+            self.logger.info(
+                "proposed block %d/%d %s", height, round_, block_id.hash.hex()[:12]
+            )
+
+        # signing may hit a remote signer (socket I/O + retry backoff) —
+        # run it off-loop; the receive routine awaits the job before
+        # taking the next input, so SM ordering is unchanged (the
+        # reference's receiveRoutine blocks on SignProposal the same way)
+        self._queue_signing(
+            lambda: self.priv_validator.sign_proposal(self.state.chain_id, proposal),
+            on_signed,
+            "proposal",
+        )
 
     # ------------------------------------------------------------------
     # proposal intake
@@ -1002,14 +1104,19 @@ class ConsensusState(Service):
             minimum = self.rs.proposal_block.header.time_ns + 1_000_000
         return max(now, minimum)
 
-    def _sign_vote(self, type_: SignedMsgType, block_id: BlockID) -> Vote | None:
+    def _sign_add_vote(self, type_: SignedMsgType, block_id: BlockID) -> None:
+        """Reference signAddVote state.go:2262. The unsigned vote is built
+        synchronously (height/round/time are snapshotted here); the privval
+        signature itself is produced off-loop via the signing queue."""
+        if self._replay_mode:
+            return
         if self.priv_validator is None:
-            return None
+            return
         pub = self.priv_validator.get_pub_key()
         addr = pub.address()
         idx, val = self.rs.validators.get_by_address(addr)
         if val is None:
-            return None  # not a validator
+            return  # not a validator
         vote = Vote(
             type=type_,
             height=self.rs.height,
@@ -1019,24 +1126,13 @@ class ConsensusState(Service):
             validator_address=addr,
             validator_index=idx,
         )
-        try:
-            return self.priv_validator.sign_vote(self.state.chain_id, vote)
-        except Exception as e:
-            self.logger.error("failed signing vote: %r", e)
-            return None
 
-    def _sign_add_vote(self, type_: SignedMsgType, block_id: BlockID) -> None:
-        """Reference signAddVote state.go:2262."""
-        if self._replay_mode:
-            return
-        if self.priv_validator is None:
-            return
-        if not self.rs.validators.has_address(
-            self.priv_validator.get_pub_key().address()
-        ):
-            return
-        vote = self._sign_vote(type_, block_id)
-        if vote is None:
-            return
-        self._send_internal(MsgInfo(m.VoteMessage(vote)))
-        self._broadcast(m.VoteMessage(vote))
+        def on_signed(signed: Vote) -> None:
+            self._send_internal(MsgInfo(m.VoteMessage(signed)))
+            self._broadcast(m.VoteMessage(signed))
+
+        self._queue_signing(
+            lambda: self.priv_validator.sign_vote(self.state.chain_id, vote),
+            on_signed,
+            "vote",
+        )

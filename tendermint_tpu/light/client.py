@@ -139,13 +139,20 @@ class LightClient:
         if latest is None:
             latest = await self.initialize()
         target = await self.primary.light_block(height)
+        # Strategies BUFFER newly verified blocks instead of persisting:
+        # nothing primary-supplied may reach the trusted store until the
+        # witness cross-check has passed, or a divergence would leave
+        # forged intermediate headers behind as future trust anchors.
+        pending: list[LightBlock] = []
         if target.height < latest.height:
-            verified = await self._verify_backwards(target, latest)
+            verified = await self._verify_backwards(target, latest, pending)
         elif self.sequential:
-            verified = await self._verify_sequential(latest, target, now_ns)
+            verified = await self._verify_sequential(latest, target, now_ns, pending)
         else:
-            verified = await self._verify_skipping(latest, target, now_ns)
+            verified = await self._verify_skipping(latest, target, now_ns, pending)
         await self._detect_divergence(verified, now_ns)
+        for lb in pending:
+            self.store.save(lb)
         self.store.save(verified)
         return verified
 
@@ -157,7 +164,11 @@ class LightClient:
     # -- strategies ------------------------------------------------------
 
     async def _verify_sequential(
-        self, trusted: LightBlock, target: LightBlock, now_ns: int
+        self,
+        trusted: LightBlock,
+        target: LightBlock,
+        now_ns: int,
+        pending: list[LightBlock],
     ) -> LightBlock:
         """Reference verifySequential client.go:546."""
         for h in range(trusted.height + 1, target.height + 1):
@@ -165,18 +176,22 @@ class LightClient:
             verifier.verify_adjacent(
                 self.chain_id, trusted, lb, self.trust_options.period_ns, now_ns
             )
-            self.store.save(lb)
+            pending.append(lb)
             trusted = lb
         return trusted
 
     async def _verify_skipping(
-        self, trusted: LightBlock, target: LightBlock, now_ns: int
+        self,
+        trusted: LightBlock,
+        target: LightBlock,
+        now_ns: int,
+        pending: list[LightBlock],
     ) -> LightBlock:
         """Bisection (reference verifySkipping client.go:639): try to jump
         straight to the target; on 1/3-overlap failure, bisect."""
-        pending = [target]
-        while pending:
-            lb = pending[-1]
+        stack = [target]
+        while stack:
+            lb = stack[-1]
             try:
                 verifier.verify(
                     self.chain_id,
@@ -192,15 +207,15 @@ class LightClient:
                     raise VerificationError(
                         "bisection cannot make progress (validator sets too disjoint)"
                     )
-                pending.append(await self.primary.light_block(mid))
+                stack.append(await self.primary.light_block(mid))
                 continue
-            self.store.save(lb)
+            pending.append(lb)
             trusted = lb
-            pending.pop()
+            stack.pop()
         return trusted
 
     async def _verify_backwards(
-        self, target: LightBlock, trusted: LightBlock
+        self, target: LightBlock, trusted: LightBlock, pending: list[LightBlock]
     ) -> LightBlock:
         """Hash-linkage verification for heights below the trusted head
         (reference client.go:878): walk last_block_id back to the target."""
@@ -218,7 +233,7 @@ class LightClient:
                     f"backwards verification failed at height {prev_height}: "
                     "hash chain broken"
                 )
-            self.store.save(prev)
+            pending.append(prev)
             cur = prev
         return cur
 

@@ -105,6 +105,9 @@ class PriorityMempool(Mempool):
         # set when txs are available; consensus wait-for-txs hook
         self._txs_available: asyncio.Event = asyncio.Event()
         self.notified_txs_available = False
+        # pulsed by update() when it resets notified_txs_available, so the
+        # consensus txs-available waiter sleeps instead of polling
+        self._notified_reset: asyncio.Event = asyncio.Event()
 
     # -- admission -------------------------------------------------------
 
@@ -210,7 +213,8 @@ class PriorityMempool(Mempool):
             self._txs_available.set()
         else:
             self._txs_available.clear()
-            self.notified_txs_available = False
+        self.notified_txs_available = False
+        self._notified_reset.set()
 
     async def _recheck(self) -> None:
         """Re-run CheckTx(RECHECK) on all resident txs after a block
@@ -248,3 +252,9 @@ class PriorityMempool(Mempool):
 
     async def wait_for_txs(self) -> None:
         await self._txs_available.wait()
+
+    async def wait_notified_reset(self) -> None:
+        """Block until the next post-commit reset of the once-per-height
+        txs-available notification latch."""
+        self._notified_reset.clear()
+        await self._notified_reset.wait()

@@ -231,6 +231,7 @@ class Node(Service):
             evidence_pool=self.evidence_pool,
             wal=wal,
             event_bus=self.event_bus,
+            mempool=self.mempool,
         )
         self.cs_reactor = ConsensusReactor(
             self.consensus,
@@ -265,6 +266,7 @@ class Node(Service):
             self.ss_lb_ch,
             self.ss_params_ch,
             self.peer_manager.subscribe(),
+            initial_height=self.genesis.initial_height,
         )
 
         from .libs.metrics import NodeMetrics, observe_block

@@ -119,10 +119,12 @@ class StateSyncReactor(Service):
         params_ch: Channel,
         peer_updates: asyncio.Queue,
         *,
+        initial_height: int = 1,
         logger: logging.Logger | None = None,
     ):
         super().__init__("ss-reactor", logger)
         self.chain_id = chain_id
+        self.initial_height = initial_height
         self.app_conns = app_conns
         self.state_store = state_store
         self.block_store = block_store
@@ -353,7 +355,7 @@ class StateSyncReactor(Service):
         # build + persist State (reference stateprovider State())
         state = State(
             chain_id=self.chain_id,
-            initial_height=1,
+            initial_height=self.initial_height,
             last_block_height=h,
             last_block_id=lb_h1.header.last_block_id,
             last_block_time_ns=lb_h.header.time_ns,

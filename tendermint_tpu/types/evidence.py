@@ -159,7 +159,7 @@ class LightClientAttackEvidence:
         out: list[Validator] = []
         if self.conflicting_header_is_invalid(trusted_signed_header.header):
             for sig in conflicting_commit.signatures:
-                if not sig.for_block():
+                if not sig.is_commit():
                     continue
                 _, val = common_vals.get_by_address(sig.validator_address)
                 if val is not None:
@@ -168,10 +168,10 @@ class LightClientAttackEvidence:
             trusted_signers = {
                 s.validator_address
                 for s in trusted_signed_header.commit.signatures
-                if s.for_block()
+                if s.is_commit()
             }
             for sig in conflicting_commit.signatures:
-                if not sig.for_block() or sig.validator_address not in trusted_signers:
+                if not sig.is_commit() or sig.validator_address not in trusted_signers:
                     continue
                 _, val = self.conflicting_block.validators.get_by_address(
                     sig.validator_address

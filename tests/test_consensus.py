@@ -282,3 +282,40 @@ class TestWALReplay:
                 assert node2.block_store.height() > height_before
             finally:
                 await node2.stop()
+
+
+class TestNoEmptyBlocks:
+    """create_empty_blocks=false (reference state.go:919 handleTxsAvailable):
+    the chain must idle with an empty mempool and resume when txs arrive,
+    even with create_empty_blocks_interval=0."""
+
+    @pytest.mark.asyncio
+    async def test_stalls_empty_then_advances_on_tx(self):
+        from dataclasses import replace
+
+        from tendermint_tpu.consensus.harness import LocalNetwork, fast_config
+
+        cfg = replace(fast_config(), create_empty_blocks=False)
+        net = LocalNetwork(2, config=cfg)
+        await net.start()
+        try:
+            # proof blocks still happen: height 1 (initial height) always,
+            # and height 2 because executing block 1 changed the app hash
+            # (genesis "" -> hash of empty kv state). Then: stall.
+            await net.wait_for_height(2, timeout=20)
+            await asyncio.sleep(1.0)
+            assert all(n.cs.rs.height == 3 for n in net.nodes), (
+                "produced an empty non-proof block despite "
+                f"create_empty_blocks=false: {[n.cs.rs.height for n in net.nodes]}"
+            )
+            # inject a tx into every mempool -> consensus must wake and
+            # commit it (block 3), plus one proof block (4), then stall at 5
+            for n in net.nodes:
+                await n.mempool.check_tx(b"k=v")
+            await net.wait_for_height(4, timeout=20)
+            blk = net.nodes[0].block_store.load_block(3)
+            assert b"k=v" in blk.txs
+            await asyncio.sleep(1.0)
+            assert all(n.cs.rs.height == 5 for n in net.nodes)
+        finally:
+            await net.stop()

@@ -1,0 +1,157 @@
+"""LightClientAttackEvidence: attribution (lunatic / equivocation /
+amnesia branches of GetByzantineValidators, reference types/evidence.go),
+encode/decode round-trip, hash stability, validate_basic."""
+
+import pytest
+
+from tendermint_tpu.light.types import LightBlock, SignedHeader
+from tendermint_tpu.testing import make_commit, make_validator_set
+from tendermint_tpu.types.block import BlockID, Header, PartSetHeader
+from tendermint_tpu.types.evidence import (
+    LightClientAttackEvidence,
+    decode_evidence,
+)
+from tendermint_tpu.crypto.hashes import sha256
+
+CHAIN = "lc-attack-chain"
+TS = 1_700_000_000_000_000_000
+
+
+def _header(vals, height=10, app_hash=b"\x01" * 32, data_hash=b"\x02" * 32):
+    return Header(
+        chain_id=CHAIN,
+        height=height,
+        time_ns=TS,
+        last_block_id=BlockID(sha256(b"prev"), PartSetHeader(1, sha256(b"pp"))),
+        last_commit_hash=sha256(b"lc"),
+        data_hash=data_hash,
+        validators_hash=vals.hash(),
+        next_validators_hash=vals.hash(),
+        consensus_hash=sha256(b"consensus"),
+        app_hash=app_hash,
+        last_results_hash=sha256(b"results"),
+        evidence_hash=b"",
+        proposer_address=vals.validators[0].address,
+    )
+
+
+def _signed_light_block(vals, keys, header, round_=0):
+    bid = BlockID(header.hash(), PartSetHeader(1, sha256(b"parts")))
+    commit = make_commit(CHAIN, header.height, round_, bid, vals, keys)
+    return LightBlock(SignedHeader(header, commit), vals)
+
+
+@pytest.fixture()
+def net():
+    vals, keys = make_validator_set(4)
+    trusted_header = _header(vals)
+    trusted = _signed_light_block(vals, keys, trusted_header)
+    return vals, keys, trusted
+
+
+def _evidence(conflicting, vals, byz=()):
+    return LightClientAttackEvidence(
+        conflicting_block=conflicting,
+        common_height=5,
+        byzantine_validators=tuple(byz),
+        total_voting_power=vals.total_voting_power(),
+        timestamp_ns=TS,
+    )
+
+
+class TestAttribution:
+    def test_lunatic_attribution(self, net):
+        """Forged app_hash → lunatic: every common-set validator that
+        signed the conflicting block is byzantine."""
+        vals, keys, trusted = net
+        forged = _header(vals, app_hash=b"\xff" * 32)
+        conflicting = _signed_light_block(vals, keys, forged)
+        ev = _evidence(conflicting, vals)
+        assert ev.conflicting_header_is_invalid(trusted.header)
+        byz = ev.get_byzantine_validators(vals, trusted.signed_header)
+        assert {v.address for v in byz} == {v.address for v in vals.validators}
+
+    def test_lunatic_attribution_skips_non_common_validators(self, net):
+        """Only validators in the common (trusted) set are attributable."""
+        vals, keys, trusted = net
+        other_vals, other_keys = make_validator_set(4, seed=b"other")
+        forged = _header(other_vals, app_hash=b"\xff" * 32)
+        conflicting = _signed_light_block(other_vals, other_keys, forged)
+        ev = _evidence(conflicting, other_vals)
+        byz = ev.get_byzantine_validators(vals, trusted.signed_header)
+        assert byz == []  # disjoint set: nothing attributable to common vals
+
+    def test_equivocation_attribution(self, net):
+        """Valid state fields, same round, different block → validators who
+        signed BOTH blocks equivocated."""
+        vals, keys, trusted = net
+        # same derived-state fields, different data_hash → different hash
+        other = _header(vals, data_hash=b"\xaa" * 32)
+        conflicting = _signed_light_block(vals, keys, other, round_=0)
+        ev = _evidence(conflicting, vals)
+        assert not ev.conflicting_header_is_invalid(trusted.header)
+        byz = ev.get_byzantine_validators(vals, trusted.signed_header)
+        assert {v.address for v in byz} == {v.address for v in vals.validators}
+
+    def test_amnesia_not_attributable(self, net):
+        """Different rounds with valid state fields → amnesia: empty."""
+        vals, keys, trusted = net
+        other = _header(vals, data_hash=b"\xaa" * 32)
+        conflicting = _signed_light_block(vals, keys, other, round_=1)
+        ev = _evidence(conflicting, vals)
+        byz = ev.get_byzantine_validators(vals, trusted.signed_header)
+        assert byz == []
+
+
+class TestCodecAndValidation:
+    def test_encode_decode_hash_roundtrip(self, net):
+        vals, keys, trusted = net
+        forged = _header(vals, app_hash=b"\xff" * 32)
+        conflicting = _signed_light_block(vals, keys, forged)
+        ev = _evidence(conflicting, vals, byz=vals.validators[:2])
+        ev.validate_basic()
+        data = ev.encode()
+        ev2 = decode_evidence(data)
+        assert isinstance(ev2, LightClientAttackEvidence)
+        assert ev2.common_height == ev.common_height
+        assert ev2.total_voting_power == ev.total_voting_power
+        assert ev2.timestamp_ns == ev.timestamp_ns
+        assert len(ev2.byzantine_validators) == 2
+        assert ev2.conflicting_block.header.hash() == forged.hash()
+        assert ev2.hash() == ev.hash()
+        assert ev2.encode() == data
+
+    def test_hash_ignores_attribution(self, net):
+        """The same attack reported with different byzantine attributions
+        must dedupe to one evidence entry."""
+        vals, keys, trusted = net
+        forged = _header(vals, app_hash=b"\xff" * 32)
+        conflicting = _signed_light_block(vals, keys, forged)
+        a = _evidence(conflicting, vals, byz=())
+        b = _evidence(conflicting, vals, byz=vals.validators[:1])
+        assert a.hash() == b.hash()
+
+    def test_validate_basic_rejects_bad_fields(self, net):
+        vals, keys, trusted = net
+        forged = _header(vals, app_hash=b"\xff" * 32)
+        conflicting = _signed_light_block(vals, keys, forged)
+        with pytest.raises(ValueError):
+            _evidence(None, vals).validate_basic()
+        bad = LightClientAttackEvidence(
+            conflicting_block=conflicting,
+            common_height=0,
+            byzantine_validators=(),
+            total_voting_power=40,
+            timestamp_ns=TS,
+        )
+        with pytest.raises(ValueError):
+            bad.validate_basic()
+        beyond = LightClientAttackEvidence(
+            conflicting_block=conflicting,
+            common_height=conflicting.height + 1,
+            byzantine_validators=(),
+            total_voting_power=40,
+            timestamp_ns=TS,
+        )
+        with pytest.raises(ValueError):
+            beyond.validate_basic()
