@@ -98,10 +98,221 @@ def init_backend(attempts: int = 3, timeout_s: float = 180.0) -> str:
     return jax.devices()[0].platform
 
 
+def _build_commit_items(n_vals, n_commits, chain_id="bench-chain"):
+    from tendermint_tpu import testing as tt
+
+    vals, keys = tt.make_validator_set(n_vals, power=10)
+    commits = []
+    for h in range(1, n_commits + 1):
+        bid = tt.make_block_id(b"block-%d" % h)
+        commits.append((bid, tt.make_commit(chain_id, h, 0, bid, vals, keys)))
+    items = []
+    for _, commit in commits:
+        for idx, cs in enumerate(commit.signatures):
+            val = vals.validators[idx]
+            items.append(
+                (val.pub_key.bytes(), commit.vote_sign_bytes(chain_id, idx), cs.signature)
+            )
+    return vals, keys, commits, items
+
+
+def bench_light_client(n_headers: int, n_vals: int) -> float:
+    """BASELINE config 2: sequential VerifyAdjacent over a chain of signed
+    headers (reference light/client_benchmark_test.go shape), every commit
+    going through the real verify_commit_light -> batch verifier path.
+    Returns headers/sec."""
+    import time as _t
+
+    from tendermint_tpu import testing as tt
+    from tendermint_tpu.crypto.hashes import sha256
+    from tendermint_tpu.light import verifier
+    from tendermint_tpu.light.types import LightBlock, SignedHeader
+    from tendermint_tpu.types.block import BlockID, Header, PartSetHeader
+
+    chain_id = "light-bench"
+    vals, keys = tt.make_validator_set(n_vals, power=10)
+    vh = vals.hash()
+    t0 = _t.perf_counter()
+    blocks = []
+    base_ts = 1_700_000_000_000_000_000
+    prev_hash = sha256(b"genesis")
+    for h in range(1, n_headers + 1):
+        hdr = Header(
+            chain_id=chain_id,
+            height=h,
+            time_ns=base_ts + h * 1_000_000_000,
+            last_block_id=BlockID(prev_hash, PartSetHeader(1, sha256(b"pp"))),
+            data_hash=sha256(b"data-%d" % h),
+            validators_hash=vh,
+            next_validators_hash=vh,
+            consensus_hash=sha256(b"consensus"),
+            app_hash=sha256(b"app-%d" % h),
+            last_results_hash=sha256(b"res"),
+            proposer_address=vals.validators[h % n_vals].address,
+        )
+        bid = BlockID(hdr.hash(), PartSetHeader(1, sha256(b"parts-%d" % h)))
+        commit = tt.make_commit(
+            chain_id, h, 0, bid, vals, keys, timestamp_ns=hdr.time_ns
+        )
+        blocks.append(LightBlock(SignedHeader(hdr, commit), vals))
+        prev_hash = hdr.hash()
+    log(f"light: built {n_headers} signed headers in {_t.perf_counter()-t0:.1f}s")
+
+    period = 10 * 365 * 24 * 3600 * 10**9
+    now_ns = base_ts + (n_headers + 10) * 1_000_000_000
+    t0 = _t.perf_counter()
+    trusted = blocks[0]
+    for lb in blocks[1:]:
+        verifier.verify_adjacent(chain_id, trusted, lb, period, now_ns)
+        trusted = lb
+    dt = _t.perf_counter() - t0
+    rate = (n_headers - 1) / dt
+    log(f"light: verified {n_headers-1} adjacent headers in {dt:.2f}s -> {rate:,.1f} headers/s")
+    return rate
+
+
+async def _bench_blocksync_async(n_blocks: int, n_vals: int, window: int) -> float:
+    """BASELINE config 3: replay a prebuilt kvstore chain through the REAL
+    blocksync reactor (fetch -> range-batched verify -> ApplyBlock) over an
+    in-process channel bridge. Returns blocks/sec."""
+    import asyncio
+    import time as _t
+
+    from tendermint_tpu import testing as tt
+    from tendermint_tpu.abci.kvstore import KVStoreApp
+    from tendermint_tpu.blocksync import BLOCKSYNC_CHANNEL
+    from tendermint_tpu.blocksync import messages as bsm
+    from tendermint_tpu.blocksync.reactor import BlockSyncReactor
+    from tendermint_tpu.consensus.harness import make_genesis
+    from tendermint_tpu.p2p.peermanager import PeerStatus, PeerUpdate
+    from tendermint_tpu.p2p.router import Channel
+    from tendermint_tpu.proxy import AppConns
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.blockstore import BlockStore
+    from tendermint_tpu.store.db import MemDB
+    from tendermint_tpu.testing import det_priv_keys
+    from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+    # genesis with n_vals validators
+    keys = det_priv_keys(n_vals)
+    gvals = [GenesisValidator(k.pub_key(), 10, f"v{i}") for i, k in enumerate(keys)]
+    genesis = GenesisDoc(
+        chain_id="bs-bench",
+        initial_height=1,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=gvals,
+    )
+    by_addr = {k.pub_key().address(): k for k in keys}
+
+    async def build_source():
+        app = KVStoreApp()
+        conns = AppConns.local(app)
+        bstore = BlockStore(MemDB())
+        sstore = StateStore(MemDB())
+        state = state_from_genesis(genesis)
+        from tendermint_tpu.consensus.replay import Handshaker
+
+        state = await Handshaker(sstore, state, bstore, genesis).handshake(conns)
+        sstore.save(state)
+        ex = BlockExecutor(sstore, conns.consensus, block_store=bstore)
+        commit = None
+        t0 = _t.perf_counter()
+        for h in range(1, n_blocks + 1):
+            block, parts = ex.create_proposal_block(
+                h, state, commit, state.validators.get_proposer().address
+            )
+            bid = block.block_id(parts.header)
+            bstore.save_block(block, parts, None)
+            state, _ = await ex.apply_block(state, bid, block)
+            commit = tt.make_commit(
+                "bs-bench", h, 0, bid, state.last_validators, by_addr,
+                timestamp_ns=block.header.time_ns + 1,
+            )
+            bstore.save_seen_commit(h, commit)
+        log(f"blocksync: built {n_blocks}-block chain in {_t.perf_counter()-t0:.1f}s")
+        return bstore, conns
+
+    src_store, src_conns = await build_source()
+
+    # target node: fresh state, real reactor
+    app = KVStoreApp()
+    conns = AppConns.local(app)
+    bstore = BlockStore(MemDB())
+    sstore = StateStore(MemDB())
+    state = state_from_genesis(genesis)
+    from tendermint_tpu.consensus.replay import Handshaker
+
+    state = await Handshaker(sstore, state, bstore, genesis).handshake(conns)
+    sstore.save(state)
+    ex = BlockExecutor(sstore, conns.consensus, block_store=bstore)
+
+    ch = Channel(
+        BLOCKSYNC_CHANNEL, "blocksync", 5, bsm.encode_message, bsm.decode_message
+    )
+    peer_q: asyncio.Queue = asyncio.Queue()
+    reactor = BlockSyncReactor(
+        state, ex, bstore, ch, peer_q, window=window, active=True
+    )
+
+    async def serve_peer():
+        """Answer the reactor's outbound envelopes from the source store
+        (the in-process stand-in for a remote peer's reactor)."""
+        while True:
+            env = await ch.out_q.get()
+            msg = env.message
+            from tendermint_tpu.p2p.types import Envelope
+
+            if isinstance(msg, bsm.StatusRequest):
+                await ch.in_q.put(
+                    Envelope(
+                        BLOCKSYNC_CHANNEL,
+                        bsm.StatusResponse(src_store.height(), src_store.base()),
+                        from_="peer0",
+                    )
+                )
+            elif isinstance(msg, bsm.BlockRequest):
+                block = src_store.load_block(msg.height)
+                if block is not None:
+                    await ch.in_q.put(
+                        Envelope(
+                            BLOCKSYNC_CHANNEL,
+                            bsm.BlockResponse(block),
+                            from_="peer0",
+                        )
+                    )
+
+    server = asyncio.get_running_loop().create_task(serve_peer())
+    await peer_q.put(PeerUpdate("peer0", PeerStatus.UP))
+    t0 = _t.perf_counter()
+    await reactor.start()
+    await asyncio.wait_for(reactor.synced.wait(), timeout=3600)
+    dt = _t.perf_counter() - t0
+    server.cancel()
+    await reactor.stop()
+    await conns.stop()
+    await src_conns.stop()
+    applied = reactor.metrics["blocks_applied"]
+    sigs = reactor.metrics["sigs_verified"]
+    assert bstore.height() >= n_blocks - 1, (bstore.height(), n_blocks)
+    rate = applied / dt
+    log(
+        f"blocksync: applied {applied} blocks ({sigs} sigs verified, "
+        f"{reactor.metrics['ranges']} ranges) in {dt:.2f}s -> {rate:,.1f} blocks/s"
+    )
+    return rate
+
+
+def bench_blocksync(n_blocks: int, n_vals: int, window: int) -> float:
+    import asyncio
+
+    return asyncio.run(_bench_blocksync_async(n_blocks, n_vals, window))
+
+
 def main() -> None:
     import numpy as np
 
-    from tendermint_tpu import testing as tt
     from tendermint_tpu.crypto.batch import CPUBatchVerifier
     from tendermint_tpu.crypto.ed25519 import Ed25519PubKey
     from tendermint_tpu.crypto.tpu import verify as tpuv
@@ -113,8 +324,8 @@ def main() -> None:
     log(f"jax backend: {backend}")
     reps = 3
     if backend == "cpu":
-        # 3 commits = 450 sigs → the 512 pad bucket (not 1024): the CPU
-        # fallback is minutes-per-kernel-call, so padding waste matters
+        # CPU fallback exists to record a nonzero number, not to race the
+        # chip: tiny batch, one bucket, secondary configs skipped
         default_commits, reps = "3", 1
     else:
         # enough commits that the padded batch lands on the 8192 bucket
@@ -124,35 +335,38 @@ def main() -> None:
     n_vals = 150
     chain_id = "bench-chain"
     log(f"building {n_vals}-validator set + commits …")
-    vals, keys = tt.make_validator_set(n_vals, power=10)
-    commits = []
-    for h in range(1, n_commits + 1):
-        bid = tt.make_block_id(b"block-%d" % h)
-        commits.append((bid, tt.make_commit(chain_id, h, 0, bid, vals, keys)))
-
-    # flatten to (pub, msg, sig) triples — the block-sync range batch
-    items = []
-    for _, commit in commits:
-        for idx, cs in enumerate(commit.signatures):
-            val = vals.validators[idx]
-            items.append(
-                (val.pub_key.bytes(), commit.vote_sign_bytes(chain_id, idx), cs.signature)
-            )
+    vals, keys, commits, items = _build_commit_items(n_vals, n_commits, chain_id)
     log(f"{len(commits)} commits, {len(items)} signatures")
 
     # -- CPU baseline -----------------------------------------------------
     base_items = items[: n_vals * 4]
-    bv = CPUBatchVerifier()
+    bv = CPUBatchVerifier(parallel=False)
     for pub, msg, sig in base_items:
         bv.add(Ed25519PubKey(pub), msg, sig)
     t0 = time.perf_counter()
-    ok, bitmap = bv.verify()
+    ok, _ = bv.verify()
     cpu_dt = time.perf_counter() - t0
     assert ok, "CPU baseline verification failed"
     cpu_rate = len(base_items) / cpu_dt
-    log(f"CPU baseline: {cpu_rate:,.0f} sigs/s ({cpu_dt*1e3:.1f} ms / {len(base_items)})")
+    log(f"CPU baseline (1 thread): {cpu_rate:,.0f} sigs/s ({cpu_dt*1e3:.1f} ms / {len(base_items)})")
 
-    # -- TPU path ---------------------------------------------------------
+    bv = CPUBatchVerifier(parallel=True)
+    for pub, msg, sig in base_items:
+        bv.add(Ed25519PubKey(pub), msg, sig)
+    bv.verify()  # warm the pool
+    bv2 = CPUBatchVerifier(parallel=True)
+    for pub, msg, sig in base_items:
+        bv2.add(Ed25519PubKey(pub), msg, sig)
+    t0 = time.perf_counter()
+    ok, _ = bv2.verify()
+    cpu_mt_dt = time.perf_counter() - t0
+    cpu_mt_rate = len(base_items) / cpu_mt_dt
+    log(
+        f"CPU baseline ({os.cpu_count()} cores): {cpu_mt_rate:,.0f} sigs/s "
+        f"({cpu_mt_dt*1e3:.1f} ms / {len(base_items)})"
+    )
+
+    # -- TPU path (batch-equation kernel) --------------------------------
     # warmup (compile; persistent cache makes repeat runs cheap). Run it on
     # a watchdog thread: a tunnel that came up for init can still wedge on
     # the first compile/execute, and a hang here must degrade to the CPU
@@ -162,13 +376,13 @@ def main() -> None:
 
     def do_warmup():
         try:
-            wres["bitmap"] = tpuv.verify_batch(items)
+            wres["bitmap"] = tpuv.verify_batch_eq(items)
         except Exception as e:  # noqa: BLE001
             wres["error"] = e
 
     wt = threading.Thread(target=do_warmup, daemon=True)
     wt.start()
-    wt.join(600.0 if backend != "cpu" else 3600.0)
+    wt.join(900.0 if backend != "cpu" else 3600.0)
     if "bitmap" not in wres:
         if os.environ.get("TMTPU_BENCH_FORCED_CPU") == "1" or backend == "cpu":
             raise RuntimeError(f"warmup failed on CPU backend: {wres.get('error')!r}")
@@ -177,21 +391,43 @@ def main() -> None:
     assert bool(np.all(bitmap)), "verification failed on valid commits"
     log(f"warmup+compile: {time.perf_counter()-t0:.1f}s")
 
-    # rejection path: corrupt one signature, expect exactly that index bad
-    bad_items = list(items)
+    # rejection path on a SMALL batch (the per-signature fallback kernel
+    # compiles at the floor bucket, not the big range bucket)
+    t0 = time.perf_counter()
+    bad_items = list(items[:64])
     pub0, msg0, sig0 = bad_items[7]
     bad_items[7] = (pub0, msg0, sig0[:63] + bytes([sig0[63] ^ 0x01]))
-    bm = tpuv.verify_batch(bad_items)
+    bm = tpuv.verify_batch_eq(bad_items)
     assert not bm[7] and bm[:7].all() and bm[8:].all(), "bad-sig bitmap wrong"
-    log("corrupted-signature rejection: ok")
+    log(f"corrupted-signature rejection: ok ({time.perf_counter()-t0:.1f}s incl fallback compile)")
 
     t0 = time.perf_counter()
     for _ in range(reps):
-        bitmap = tpuv.verify_batch(items)
+        bitmap = tpuv.verify_batch_eq(items)
     tpu_dt = (time.perf_counter() - t0) / reps
     assert bool(np.all(bitmap))
     tpu_rate = len(items) / tpu_dt
     log(f"{backend} end-to-end: {tpu_rate:,.0f} sigs/s ({tpu_dt*1e3:.1f} ms / {len(items)})")
+
+    # -- secondary configs (BASELINE.md 2 and 3) --------------------------
+    extra = {}
+    if backend != "cpu":
+        from tendermint_tpu.crypto import batch as crypto_batch
+
+        crypto_batch.tpu_verifier_available(blocking=True)
+        try:
+            extra["light_headers_per_s"] = round(bench_light_client(1000, n_vals), 1)
+        except Exception as e:  # noqa: BLE001
+            log(f"light bench failed: {e!r}")
+        try:
+            extra["blocksync_blocks_per_s"] = round(
+                bench_blocksync(1024, n_vals, window=54), 1
+            )
+        except Exception as e:  # noqa: BLE001
+            log(f"blocksync bench failed: {e!r}")
+    else:
+        log("secondary configs skipped on cpu fallback")
+    extra["cpu_multicore_sigs_per_s"] = round(cpu_mt_rate, 1)
 
     print(
         json.dumps(
@@ -200,6 +436,7 @@ def main() -> None:
                 "value": round(tpu_rate, 1),
                 "unit": "sigs/sec",
                 "vs_baseline": round(tpu_rate / cpu_rate, 2),
+                "extra": extra,
             }
         )
     )

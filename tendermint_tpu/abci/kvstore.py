@@ -24,15 +24,22 @@ SNAPSHOT_FORMAT = 1
 _STATE_KEY = b"__kvstore_state__"
 
 
+def _sorted_leaves(items: dict[bytes, bytes]) -> list[bytes]:
+    from ..crypto import merkle
+
+    return [merkle.kv_leaf(k, v) for k, v in sorted(items.items())]
+
+
 def _state_hash(items: dict[bytes, bytes]) -> bytes:
-    """Hash of the key-value data only — deliberately NOT height-salted:
-    an empty block must leave the app hash unchanged, or consensus's
-    needProofBlock would force a proof block after every empty block
-    (reference kvstore hashes tree size, same property)."""
-    enc = json.dumps(
-        {k.hex(): v.hex() for k, v in sorted(items.items())}, sort_keys=True
-    ).encode()
-    return sha256(enc)
+    """RFC 6962 merkle root over the sorted (key, value) pairs — so
+    `abci_query(prove=True)` can return an inclusion proof that the light
+    RPC client checks against a verified header's app_hash. Deliberately
+    NOT height-salted: an empty block must leave the app hash unchanged,
+    or consensus's needProofBlock would force a proof block after every
+    empty block (reference kvstore hashes tree size, same property)."""
+    from ..crypto import merkle
+
+    return merkle.hash_from_byte_slices(_sorted_leaves(items))
 
 
 class KVStoreApp(BaseApplication):
@@ -50,6 +57,7 @@ class KVStoreApp(BaseApplication):
         self._snapshot_data: dict[tuple[int, int], bytes] = {}
         self._restore_chunks: list[bytes] | None = None
         self._restore_target: abci.Snapshot | None = None
+        self._proof_cache: dict[bytes, object] | None = None
         self._load()
 
     # -- persistence ------------------------------------------------------
@@ -97,7 +105,22 @@ class KVStoreApp(BaseApplication):
         value = self.items.get(req.data)
         if value is None:
             return abci.ResponseQuery(code=1, key=req.data, log="does not exist")
-        return abci.ResponseQuery(key=req.data, value=value, height=self.height)
+        proof_ops: tuple = ()
+        if req.prove:
+            from ..crypto import merkle
+
+            if self._proof_cache is None:
+                # built once per committed height (commit() invalidates),
+                # not per query — a proven point lookup is then O(1)
+                keys = sorted(self.items)
+                _, proofs = merkle.proofs_from_byte_slices(
+                    _sorted_leaves(self.items)
+                )
+                self._proof_cache = dict(zip(keys, proofs))
+            proof_ops = (merkle.value_op(req.data, self._proof_cache[req.data]),)
+        return abci.ResponseQuery(
+            key=req.data, value=value, height=self.height, proof_ops=proof_ops
+        )
 
     # -- mempool ----------------------------------------------------------
 
@@ -170,6 +193,7 @@ class KVStoreApp(BaseApplication):
     def commit(self):
         self.items.update(self._staged)
         self._staged = {}
+        self._proof_cache = None
         self.app_hash = _state_hash(self.items)
         self._save()
         self._take_snapshot()
@@ -261,6 +285,7 @@ class KVStoreApp(BaseApplication):
         self.height = d["height"]
         self.validators = {bytes.fromhex(k): p for k, p in d["validators"].items()}
         self.app_hash = _state_hash(self.items)
+        self._proof_cache = None
         self._save()
         self._restore_chunks = None
         self._restore_target = None

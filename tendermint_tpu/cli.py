@@ -133,8 +133,12 @@ def _build_node(home: str):
         moniker=cfg.moniker,
         wal_dir=os.path.join(p["data"], "cs.wal"),
         rpc_laddr=cfg.rpc.laddr if cfg.rpc.enable else "",
+        seed_mode=cfg.mode == "seed",
+        addr_book_path=os.path.join(p["config"], "addrbook.json"),
     )
-    transport = TCPTransport()
+    transport = TCPTransport(
+        send_rate=cfg.p2p.send_rate, recv_rate=cfg.p2p.recv_rate
+    )
     node = Node(
         node_config,
         genesis,
@@ -277,8 +281,10 @@ def cmd_reset(args) -> int:
 
 
 def cmd_light(args) -> int:
-    """Verify a height against a node over RPC (reference tendermint
-    light, condensed: no proxy server yet)."""
+    """Light client: verify a height over RPC, or (with --laddr) run the
+    light RPC PROXY — a JSON-RPC server whose every answer is verified
+    against the trust anchor before it is returned (reference
+    light/proxy/proxy.go:18)."""
 
     async def run() -> int:
         from .light.client import LightClient, TrustOptions
@@ -299,6 +305,22 @@ def cmd_light(args) -> int:
                 TrustOptions(args.trust_period * 10**9, args.trust_height, trust_hash),
                 provider,
             )
+            if getattr(args, "laddr", ""):
+                from .light.proxy import LightProxyEnv
+                from .rpc.server import RPCServer
+
+                server = RPCServer(LightProxyEnv(lc, client))
+                host, _, port = args.laddr.rpartition(":")
+                await server.start(host or "127.0.0.1", int(port or 0))
+                print(
+                    f"light proxy for {chain_id} via {args.address} "
+                    f"listening on {host or '127.0.0.1'}:{server.port}"
+                )
+                try:
+                    await asyncio.Event().wait()  # serve until interrupted
+                finally:
+                    await server.stop()
+                return 0
             lb = await lc.verify_light_block_at_height(args.height)
             print(
                 json.dumps(
@@ -386,6 +408,11 @@ def main(argv: list[str] | None = None) -> int:
     p_light.add_argument("--trust-height", type=int, default=1)
     p_light.add_argument("--trust-hash", default="")
     p_light.add_argument("--trust-period", type=int, default=7 * 24 * 3600)
+    p_light.add_argument(
+        "--laddr",
+        default="",
+        help="run the verifying RPC proxy on this host:port instead of a one-shot verify",
+    )
     p_light.set_defaults(fn=cmd_light)
 
     args = parser.parse_args(argv)

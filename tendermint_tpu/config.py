@@ -70,6 +70,10 @@ class P2PConfig:
     laddr: str = "0.0.0.0:26656"
     persistent_peers: str = ""  # comma-separated tcp://id@host:port
     max_connections: int = 16
+    # flow-rate limits, bytes/sec per connection (reference
+    # config/config.go SendRate/RecvRate, default 5.12 MB/s); 0 = unlimited
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
 
 
 @dataclass
@@ -101,6 +105,9 @@ class Config:
     TOML-serialized in <home>/config/config.toml."""
 
     moniker: str = "node"
+    # node mode (reference config BaseConfig.Mode, 0.35): "validator",
+    # "full", or "seed" (p2p address-crawler only, node/node.go:490)
+    mode: str = "validator"
     proxy_app: str = "kvstore"  # builtin app name (socket ABCI later)
     consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)

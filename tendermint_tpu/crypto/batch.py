@@ -14,22 +14,60 @@ import threading
 
 from . import BatchVerifier, PubKey
 from .ed25519 import KEY_TYPE as ED25519
+from .sr25519 import KEY_TYPE as SR25519
+
+_BATCHABLE = (ED25519, SR25519)
 
 logger = logging.getLogger("crypto.batch")
 
 
 class CPUBatchVerifier(BatchVerifier):
-    """Verify each entry independently on the host."""
+    """Verify each entry independently on the host. Large batches fan out
+    over a thread pool — OpenSSL-backed ed25519 verification releases the
+    GIL, so this scales with cores (the reference's Go verifier gets the
+    same from goroutines). Small batches stay on the calling thread."""
 
-    def __init__(self):
+    PARALLEL_THRESHOLD = 64
+
+    def __init__(self, *, parallel: bool | None = None):
         self._items: list[tuple[PubKey, bytes, bytes]] = []
+        self._parallel = parallel
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         self._items.append((pub_key, msg, sig))
 
     def verify(self) -> tuple[bool, list[bool]]:
-        results = [pk.verify_signature(msg, sig) for pk, msg, sig in self._items]
+        items = self._items
+        use_threads = (
+            self._parallel
+            if self._parallel is not None
+            else len(items) >= self.PARALLEL_THRESHOLD
+        )
+        if use_threads and len(items) > 1:
+            results = list(_cpu_pool().map(_verify_one, items, chunksize=16))
+        else:
+            results = [_verify_one(it) for it in items]
         return all(results) and bool(results), results
+
+
+def _verify_one(item: tuple[PubKey, bytes, bytes]) -> bool:
+    pk, msg, sig = item
+    return pk.verify_signature(msg, sig)
+
+
+_pool = None
+
+
+def _cpu_pool():
+    global _pool
+    if _pool is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _pool = ThreadPoolExecutor(
+            max_workers=min(32, os.cpu_count() or 4),
+            thread_name_prefix="sigverify",
+        )
+    return _pool
 
 
 _tpu_available: bool | None = None
@@ -38,20 +76,69 @@ _tpu_probe_started = False
 
 
 def _probe_tpu() -> None:
-    """Background probe: bring the JAX backend up and warm the kernel so
-    the first real batch doesn't pay backend-init + compile inline."""
+    """Background probe: bring the JAX backend up, warm the kernel, and
+    MEASURE the CPU/TPU crossover batch size so routing is based on this
+    host's actual rates, not a guess."""
     global _tpu_available
     try:
         from .tpu.verify import backend_ready, warmup
 
         ok = backend_ready()
         if ok:
-            warmup()
+            # fallback=True also compiles the per-signature attribution
+            # kernel: the first bad signature in a gossiped batch must not
+            # stall verification behind an inline JIT compile
+            warmup(fallback=True)
+            _measure_cutoff()
         _tpu_available = ok
         logger.info("TPU batch verifier %s", "ready" if ok else "unavailable")
     except Exception as e:
         logger.info("TPU batch verifier unavailable: %r", e)
         _tpu_available = False
+
+
+def _measure_cutoff() -> None:
+    """Derive MIN_TPU_BATCH from measurement (runs once, after warmup):
+    time one warmed device call at the floor bucket (fixed overhead
+    dominates there) and the parallel host verifier on the same batch;
+    route to the device from the size where its flat call cost beats the
+    host's per-signature rate. Honors TMTPU_MIN_TPU_BATCH as an override."""
+    global MIN_TPU_BATCH
+    if os.environ.get("TMTPU_MIN_TPU_BATCH"):
+        return
+    import time
+
+    from .ed25519 import Ed25519PrivKey
+    from .tpu.verify import _MIN_BUCKET, verify_batch_eq
+
+    priv = Ed25519PrivKey(b"\x42" * 32)
+    pub = priv.pub_key()
+    items = [
+        (pub.bytes(), b"cutoff-probe-%d" % i, priv.sign(b"cutoff-probe-%d" % i))
+        for i in range(_MIN_BUCKET)
+    ]
+    t0 = time.perf_counter()
+    verify_batch_eq(items)
+    tpu_call_s = time.perf_counter() - t0
+
+    bv = CPUBatchVerifier(parallel=True)
+    for _ in range(2):  # warm the pool, then measure
+        for pub_b, msg, sig in items:
+            bv.add(pub, msg, sig)
+        t0 = time.perf_counter()
+        bv.verify()
+        cpu_s = time.perf_counter() - t0
+        bv = CPUBatchVerifier(parallel=True)
+    cpu_rate = len(items) / max(cpu_s, 1e-9)
+    measured = int(tpu_call_s * cpu_rate) + 1
+    MIN_TPU_BATCH = max(8, min(2048, measured))
+    logger.info(
+        "measured TPU cutoff: device call %.2fms, host %.0f sigs/s -> "
+        "MIN_TPU_BATCH=%d",
+        tpu_call_s * 1e3,
+        cpu_rate,
+        MIN_TPU_BATCH,
+    )
 
 
 def tpu_verifier_available(*, blocking: bool = False) -> bool:
@@ -82,9 +169,10 @@ def tpu_verifier_available(*, blocking: bool = False) -> bool:
 
 
 # Below this many signatures the TPU round-trip (host transfer + launch
-# overhead) costs more than it saves — verify on the host instead. The
-# adaptive CPU/TPU cutoff is decided at verify() time, when the batch size
-# is known (SURVEY.md §7 hard-part #2).
+# overhead) costs more than it saves — verify on the host instead. This
+# initial value is replaced by a MEASURED crossover in _measure_cutoff()
+# when the device probe completes (SURVEY.md §7 hard-part #2);
+# TMTPU_MIN_TPU_BATCH pins it explicitly.
 MIN_TPU_BATCH = int(os.environ.get("TMTPU_MIN_TPU_BATCH", "32"))
 
 
@@ -98,8 +186,11 @@ class AdaptiveBatchVerifier(BatchVerifier):
         self._items: list[tuple[PubKey, bytes, bytes]] = []
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
-        if pub_key.TYPE != ED25519:
-            raise ValueError("adaptive batch verifier is ed25519-only")
+        if pub_key.TYPE not in _BATCHABLE:
+            raise ValueError(
+                f"adaptive batch verifier supports {_BATCHABLE}, got "
+                f"{pub_key.TYPE!r}"
+            )
         self._items.append((pub_key, msg, sig))
 
     def verify(self) -> tuple[bool, list[bool]]:
@@ -115,10 +206,12 @@ class AdaptiveBatchVerifier(BatchVerifier):
 
 
 def supports_batch_verifier(pub_key: PubKey) -> bool:
-    return pub_key.TYPE == ED25519
+    """ed25519 and sr25519 batch (reference crypto/batch/batch.go:26 —
+    same two types); secp256k1 does not (falls back to single verify)."""
+    return pub_key.TYPE in _BATCHABLE
 
 
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
-    if pub_key.TYPE == ED25519:
+    if pub_key.TYPE in _BATCHABLE:
         return AdaptiveBatchVerifier()
     raise ValueError(f"key type {pub_key.TYPE!r} does not support batch verification")

@@ -138,3 +138,124 @@ def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
         for i in range(n)
     ]
     return root, proofs
+
+
+# -- proof operators ---------------------------------------------------------
+#
+# Reference crypto/merkle/proof_op.go + proof_value.go: an abci_query proof
+# is a CHAIN of typed operators — each op maps (key-path segment, value) to
+# the next layer's root, the last op's output must equal the header's
+# app_hash. The light RPC client uses this to verify query results it did
+# not compute itself.
+
+
+@dataclass
+class ProofOp:
+    """One operator: `type_` selects the verifier, `key` is the key-path
+    segment it consumes, `data` its encoded proof payload."""
+
+    type_: str
+    key: bytes
+    data: bytes
+
+    def encode(self) -> bytes:
+        from ..libs import protoenc as pe
+
+        return (
+            pe.string_field(1, self.type_)
+            + pe.bytes_field(2, self.key)
+            + pe.bytes_field(3, self.data)
+        )
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "ProofOp":
+        from ..libs import protoenc as pe
+
+        r = pe.Reader(raw)
+        type_, key, data = "", b"", b""
+        while not r.eof():
+            f, wt = r.read_tag()
+            if f == 1:
+                type_ = r.read_bytes().decode()
+            elif f == 2:
+                key = r.read_bytes()
+            elif f == 3:
+                data = r.read_bytes()
+            else:
+                r.skip(wt)
+        return cls(type_, key, data)
+
+
+PROOF_OP_VALUE = "tmtpu:value"
+
+
+def value_op(key: bytes, proof: Proof) -> ProofOp:
+    """Key/value inclusion under a merkle-rooted KV store: the leaf is the
+    deterministic (key, value) pair encoding (reference proof_value.go
+    ValueOp, with sha256(value) folded into the leaf encoding here)."""
+    return ProofOp(PROOF_OP_VALUE, key, proof.encode())
+
+
+def kv_leaf(key: bytes, value: bytes) -> bytes:
+    from ..libs import protoenc as pe
+
+    return pe.bytes_field(1, key) + pe.bytes_field(2, value)
+
+
+def _verify_value_op(op: ProofOp, root: bytes, value: bytes) -> bool:
+    try:
+        proof = Proof.decode(op.data)
+    except Exception:
+        return False
+    return proof.verify(root, kv_leaf(op.key, value))
+
+
+_OP_VERIFIERS = {PROOF_OP_VALUE: _verify_value_op}
+
+
+class ProofOperators:
+    """Verify a chain of proof ops against an expected root and key path
+    (reference proof_op.go ProofOperators.Verify). The key path is
+    '/seg1/seg2/…' with URL-escaped segments, consumed right-to-left as
+    ops are applied bottom-up; this framework's apps use single-op paths."""
+
+    def __init__(self, ops: list[ProofOp]):
+        self.ops = list(ops)
+
+    def verify_value(self, root: bytes, keypath: str, value: bytes) -> bool:
+        from urllib.parse import unquote_to_bytes
+
+        segments = [
+            unquote_to_bytes(s) for s in keypath.split("/") if s != ""
+        ]
+        if len(segments) < len(self.ops):
+            return False
+        current = value
+        for i, op in enumerate(self.ops):
+            verifier = _OP_VERIFIERS.get(op.type_)
+            if verifier is None:
+                return False
+            expect_key = segments[len(segments) - 1 - i]
+            if op.key != expect_key:
+                return False
+            if i == len(self.ops) - 1:
+                return verifier(op, root, current)
+            # multi-op chains: intermediate ops must yield the next root —
+            # represented by the op's own computed root carried as `current`
+            try:
+                proof = Proof.decode(op.data)
+            except Exception:
+                return False
+            current = _compute_root(
+                _leaf_hash(kv_leaf(op.key, current)),
+                proof.index,
+                proof.total,
+                proof.aunts,
+            )
+        return False
+
+
+def key_path(*segments: bytes) -> str:
+    from urllib.parse import quote_from_bytes
+
+    return "/" + "/".join(quote_from_bytes(s) for s in segments)

@@ -209,7 +209,10 @@ def _scan_carry(c: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
         v = limb + carry
         return v >> 8, v & 0xFF
 
-    carry_out, limbs = lax.scan(step, jnp.zeros(c.shape[:-1], jnp.int32), c_t)
+    # init derived from the data (c_t[0] * 0), NOT jnp.zeros: under
+    # shard_map the data is varying over the mesh axis and a constant
+    # init would make the scan's carry-in/carry-out types disagree
+    carry_out, limbs = lax.scan(step, c_t[0] * 0, c_t)
     return jnp.moveaxis(limbs, 0, -1), carry_out
 
 

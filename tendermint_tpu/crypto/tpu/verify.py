@@ -66,7 +66,58 @@ def _kernel(a_bytes, r_bytes, s_digits, h_digits, s_valid):
     return a_ok & r_ok & eq_ok & s_valid
 
 
+def _kernel_eq(a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid):
+    """Randomized linear-combination batch verification (the reference's
+    actual batch algorithm, crypto/ed25519/ed25519.go:225 via
+    curve25519-voi): ONE multi-scalar multiplication
+
+        [8]( zs·B − Σ aᵢ·Aᵢ − Σ zᵢ·Rᵢ ) == O
+
+    with zs = Σ zᵢ·sᵢ mod L, aᵢ = zᵢ·kᵢ mod L, and zᵢ random 128-bit
+    coefficients sampled per call on the host. Scalars on A and R may be
+    reduced mod L even though those points can carry torsion (ZIP-215):
+    the final ×8 kills every torsion component, so only the prime-order
+    part — where mod-L reduction is exact — survives.
+
+    Inputs: a_bytes/r_bytes (N,32) int32 compressed points;
+    a_digits (32,N), r_digits (16,N), zs_digits (32,1) int32 radix-256
+    little-endian scalar digits; s_valid (N,) bool (s < L, well-formed).
+    Format-invalid entries arrive with zeroed digits; decompression
+    failures are masked to the identity in-kernel, so neither perturbs
+    the sum. Returns (ok_bitmap (N,), eq_ok ()): on eq_ok the bitmap IS
+    the per-signature answer; on failure the caller falls back to the
+    per-signature kernel for attribution (historical block-sync batches
+    are ~always all-valid, so the one-MSM happy path dominates).
+    """
+    import jax.numpy as jnp
+
+    from . import curve, msm
+    from .curve import Point
+
+    stacked, ok = curve.decompress(jnp.concatenate([a_bytes, r_bytes], axis=0))
+    n = a_bytes.shape[0]
+    A = Point(*(c[:n] for c in stacked))
+    R = Point(*(c[n:] for c in stacked))
+    ok_bitmap = ok[:n] & ok[n:] & s_valid
+
+    ident = curve.identity((n,))
+    Am = curve.point_select(ok_bitmap, curve.point_neg(A), ident)
+    Rm = curve.point_select(ok_bitmap, curve.point_neg(R), ident)
+
+    # A-group MSM carries the base point as one extra row (scalar zs)
+    bpt = curve.base_point(())
+    ga = Point(
+        *(jnp.concatenate([c, b[None]], axis=0) for c, b in zip(Am, bpt))
+    )
+    ga_digits = jnp.concatenate([a_digits, zs_digits], axis=1)
+
+    acc = curve.point_add(msm.msm(ga, ga_digits), msm.msm(Rm, r_digits))
+    eq_ok = curve.is_identity(curve.mul_by_cofactor(acc))
+    return ok_bitmap, eq_ok
+
+
 _jitted_kernel = None
+_jitted_kernel_eq = None
 _sharded_kernels: dict[int, object] = {}
 _cache_ready = False
 
@@ -104,16 +155,26 @@ def _get_kernel():
     return _jitted_kernel
 
 
-def warmup(bucket: int | None = None) -> None:
-    """Compile + execute the kernel once at the floor bucket size so the
-    first real batch pays neither backend init nor compile (the persistent
-    compile cache makes this fast after the first-ever process)."""
+def _get_kernel_eq():
+    global _jitted_kernel_eq
+    if _jitted_kernel_eq is None:
+        import jax
+
+        _ensure_compile_cache()
+        _jitted_kernel_eq = jax.jit(_kernel_eq)
+    return _jitted_kernel_eq
+
+
+def warmup(bucket: int | None = None, *, fallback: bool = False) -> None:
+    """Compile + execute the batch-equation kernel once at the floor
+    bucket size so the first real batch pays neither backend init nor
+    compile (the persistent compile cache makes this fast after the
+    first-ever process). fallback=True also warms the per-signature
+    attribution kernel (only exercised by bad batches)."""
     n = bucket or _MIN_BUCKET
-    a = np.zeros((n, 32), np.int32)
-    r = np.zeros((n, 32), np.int32)
-    digits = np.zeros((n, 64), np.int32)
-    sv = np.zeros(n, bool)
-    _get_kernel()(a, r, digits, digits, sv)
+    _get_kernel_eq()(*prepare_batch_eq([None] * n, pad_to=n))
+    if fallback:
+        _get_kernel()(*prepare_resolved([None] * n, pad_to=n))
 
 
 def make_sharded_kernel(mesh, axis: str = "data"):
@@ -133,28 +194,145 @@ def make_sharded_kernel(mesh, axis: str = "data"):
     )
 
 
+def make_sharded_kernel_eq(mesh, axis: str = "data"):
+    """Multi-chip batch-equation verification: decompression and the
+    bucket MSM are data-parallel over the signature shard on each device
+    (zero communication); each device reduces its shard to ONE partial
+    point, and the only collective in the whole kernel is the all-gather
+    of those n_dev partials (a few KB over ICI). The replicated epilogue
+    adds the zs·B term and runs the cofactored identity check.
+
+    Call with (a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid);
+    batch length must divide evenly by the mesh axis size.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    from . import curve, msm
+    from .curve import Point
+
+    _ensure_compile_cache()
+
+    def local_partial(a_bytes, r_bytes, a_digits, r_digits, s_valid):
+        stacked, ok = curve.decompress(
+            jnp.concatenate([a_bytes, r_bytes], axis=0)
+        )
+        n = a_bytes.shape[0]
+        A = Point(*(c[:n] for c in stacked))
+        R = Point(*(c[n:] for c in stacked))
+        ok_bitmap = ok[:n] & ok[n:] & s_valid
+        ident = curve.identity((n,))
+        Am = curve.point_select(ok_bitmap, curve.point_neg(A), ident)
+        Rm = curve.point_select(ok_bitmap, curve.point_neg(R), ident)
+        part = curve.point_add(msm.msm(Am, a_digits), msm.msm(Rm, r_digits))
+        # (1, 4, 32): the device's single partial point; the P(axis)
+        # out_spec concatenates them to (n_dev, 4, 32) — XLA inserts the
+        # gather collective where the replicated epilogue consumes it
+        return ok_bitmap, jnp.stack(list(part))[None]
+
+    sharded = shard_map(
+        local_partial,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(None, axis), P(None, axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+    )
+
+    def kernel(a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid):
+        ok_bitmap, parts = sharded(a_bytes, r_bytes, a_digits, r_digits, s_valid)
+        partial_pts = Point(*(parts[:, i] for i in range(4)))
+        total = msm._tree_reduce_points(  # n_dev is a power of two
+            partial_pts, axis=0
+        )
+        bpt = curve.base_point(())
+        sb = msm.msm(Point(*(c[None] for c in bpt)), zs_digits)
+        acc = curve.point_add(total, sb)
+        return ok_bitmap, curve.is_identity(curve.mul_by_cofactor(acc))
+
+    return jax.jit(kernel)
+
+
+class ResolvedSig:
+    """A signature reduced to the Edwards-form check
+    [8](s·B − k·A − R) == O — the common shape both key types share.
+    ed25519: k = SHA-512(R ‖ A ‖ msg) mod L; sr25519: k is the Merlin
+    transcript challenge and A/R are the ristretto coset representatives
+    re-encoded in ed25519 compressed form."""
+
+    __slots__ = ("a", "r", "s", "k")
+
+    def __init__(self, a: bytes, r: bytes, s: int, k: int):
+        self.a = a
+        self.r = r
+        self.s = s
+        self.k = k
+
+
+def resolve_ed25519(pub: bytes, msg: bytes, sig: bytes) -> ResolvedSig | None:
+    """None = malformed (wrong sizes or non-canonical s ≥ L)."""
+    if len(pub) != 32 or len(sig) != 64:
+        return None
+    r, s = sig[:32], sig[32:]
+    s_int = int.from_bytes(s, "little")
+    if s_int >= L:
+        return None
+    k = int.from_bytes(hashlib.sha512(r + pub + msg).digest(), "little") % L
+    return ResolvedSig(pub, r, s_int, k)
+
+
+def resolve_sr25519(pub: bytes, msg: bytes, sig: bytes) -> ResolvedSig | None:
+    from .. import sr25519
+
+    triple = sr25519.to_edwards_triple(pub, msg, sig)
+    if triple is None:
+        return None
+    a_ed, r_ed, k = triple
+    s_clear = bytearray(sig[32:])
+    s_clear[31] &= 0x7F
+    s_int = int.from_bytes(bytes(s_clear), "little")
+    if s_int >= L:
+        return None
+    return ResolvedSig(a_ed, r_ed, s_int, k)
+
+
+def resolve(pub_key, msg: bytes, sig: bytes) -> ResolvedSig | None:
+    """Dispatch on the PubKey object's TYPE."""
+    if pub_key.TYPE == "ed25519":
+        return resolve_ed25519(pub_key.bytes(), msg, sig)
+    if pub_key.TYPE == "sr25519":
+        return resolve_sr25519(pub_key.bytes(), msg, sig)
+    return None
+
+
 def prepare_batch(items: list[tuple[bytes, bytes, bytes]]):
-    """Host-side prep. items: (pubkey32, msg, sig64) triples.
-    Returns numpy arrays (a_bytes, r_bytes, s_digits, h_digits, s_valid)."""
-    n = len(items)
-    a_np = np.zeros((n, 32), np.uint8)
-    r_np = np.zeros((n, 32), np.uint8)
-    s_np = np.zeros((n, 32), np.uint8)
-    h_np = np.zeros((n, 32), np.uint8)
-    s_valid = np.zeros(n, bool)
-    for i, (pub, msg, sig) in enumerate(items):
-        if len(pub) != 32 or len(sig) != 64:
-            continue  # stays invalid
-        r, s = sig[:32], sig[32:]
-        s_int = int.from_bytes(s, "little")
-        if s_int >= L:
+    """Host-side prep for the per-signature kernel. items: (pubkey32,
+    msg, sig64) ed25519 triples. Returns numpy arrays
+    (a_bytes, r_bytes, s_digits, h_digits, s_valid)."""
+    return prepare_resolved(
+        [resolve_ed25519(pub, msg, sig) for pub, msg, sig in items]
+    )
+
+
+def prepare_resolved(entries: list[ResolvedSig | None], pad_to: int = 0):
+    """ResolvedSig list -> per-signature kernel inputs (None entries and
+    padding rows stay invalid)."""
+    n = len(entries)
+    m = max(pad_to, n)
+    a_np = np.zeros((m, 32), np.uint8)
+    r_np = np.zeros((m, 32), np.uint8)
+    s_np = np.zeros((m, 32), np.uint8)
+    h_np = np.zeros((m, 32), np.uint8)
+    s_valid = np.zeros(m, bool)
+    for i, e in enumerate(entries):
+        if e is None:
             continue
         s_valid[i] = True
-        a_np[i] = np.frombuffer(pub, np.uint8)
-        r_np[i] = np.frombuffer(r, np.uint8)
-        s_np[i] = np.frombuffer(s, np.uint8)
-        k = int.from_bytes(hashlib.sha512(r + pub + msg).digest(), "little") % L
-        h_np[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
+        a_np[i] = np.frombuffer(e.a, np.uint8)
+        r_np[i] = np.frombuffer(e.r, np.uint8)
+        s_np[i] = np.frombuffer(e.s.to_bytes(32, "little"), np.uint8)
+        h_np[i] = np.frombuffer(e.k.to_bytes(32, "little"), np.uint8)
+
     def to_digits(b: np.ndarray) -> np.ndarray:
         """(N,32) bytes -> (N,64) radix-16 little-endian digits."""
         d = np.empty((b.shape[0], 64), np.int32)
@@ -168,6 +346,80 @@ def prepare_batch(items: list[tuple[bytes, bytes, bytes]]):
         to_digits(s_np),
         to_digits(h_np),
         s_valid,
+    )
+
+
+def prepare_batch_eq(entries: list[ResolvedSig | None], pad_to: int = 0):
+    """Host prep for the batch-equation kernel. pad_to ≥ len(entries)
+    pads with inert rows (digits 0, s_valid False). Returns (a_bytes,
+    r_bytes, a_digits, r_digits, zs_digits, s_valid) numpy arrays shaped
+    for `_kernel_eq`."""
+    import os as _os
+
+    n = len(entries)
+    m = max(pad_to, n)
+    a_np = np.zeros((m, 32), np.uint8)
+    r_np = np.zeros((m, 32), np.uint8)
+    a_sc = np.zeros((m, 32), np.uint8)  # z·k mod L bytes
+    r_sc = np.zeros((m, 16), np.uint8)  # z bytes
+    s_valid = np.zeros(m, bool)
+    zs = 0
+    rnd = _os.urandom(16 * n)
+    for i, e in enumerate(entries):
+        if e is None:
+            continue
+        s_valid[i] = True
+        a_np[i] = np.frombuffer(e.a, np.uint8)
+        r_np[i] = np.frombuffer(e.r, np.uint8)
+        # z ∈ [1, 2^128): |1 excludes zero (a zero coefficient would drop
+        # the signature from the equation entirely)
+        z = int.from_bytes(rnd[16 * i : 16 * i + 16], "little") | 1
+        a_sc[i] = np.frombuffer(((z * e.k) % L).to_bytes(32, "little"), np.uint8)
+        r_sc[i] = np.frombuffer(z.to_bytes(16, "little"), np.uint8)
+        zs = (zs + z * e.s) % L
+    zs_digits = (
+        np.frombuffer(zs.to_bytes(32, "little"), np.uint8)
+        .astype(np.int32)
+        .reshape(32, 1)
+    )
+    return (
+        a_np.astype(np.int32),
+        r_np.astype(np.int32),
+        np.ascontiguousarray(a_sc.T).astype(np.int32),  # (32, m)
+        np.ascontiguousarray(r_sc.T).astype(np.int32),  # (16, m)
+        zs_digits,
+        s_valid,
+    )
+
+
+def verify_resolved(
+    entries: list[ResolvedSig | None], pad_multiple: int = 1
+) -> np.ndarray:
+    """Batch-equation verification with per-signature fallback: returns a
+    bool bitmap of length len(entries). The happy path (all signatures
+    valid) costs one MSM kernel call; a failed equation falls back to the
+    per-signature kernel to recover the bitmap (the reference bisects
+    inside voi; attribution cost only matters on the rare bad batch)."""
+    n = len(entries)
+    if n == 0:
+        return np.zeros(0, bool)
+    b = _bucket(n, pad_multiple)
+    bitmap, eq_ok = _get_kernel_eq()(*prepare_batch_eq(entries, pad_to=b))
+    if bool(eq_ok):
+        return np.asarray(bitmap)[:n]
+    out = np.asarray(
+        _get_kernel()(*prepare_resolved(entries, pad_to=b))
+    )
+    return out[:n]
+
+
+def verify_batch_eq(
+    items: list[tuple[bytes, bytes, bytes]], pad_multiple: int = 1
+) -> np.ndarray:
+    """(pubkey32, msg, sig64) ed25519 triples -> bool bitmap."""
+    return verify_resolved(
+        [resolve_ed25519(pub, msg, sig) for pub, msg, sig in items],
+        pad_multiple=pad_multiple,
     )
 
 
@@ -203,28 +455,28 @@ def verify_batch(
 
 
 class TPUBatchVerifier(BatchVerifier):
-    """BatchVerifier backed by the JAX kernel (the reference's interface,
-    crypto/crypto.go:46-54). Non-ed25519 keys degrade to host verification
-    so mixed validator sets still produce a complete bitmap."""
+    """BatchVerifier backed by the JAX batch-equation kernel (the
+    reference's interface, crypto/crypto.go:46-54). ed25519 AND sr25519
+    share the kernel — both reduce to [8](s·B − k·A − R) == O on the same
+    curve (see ResolvedSig). Other key types (secp256k1) degrade to host
+    verification so mixed validator sets still produce a complete bitmap."""
 
     def __init__(self):
-        self._items: list[tuple[bytes, bytes, bytes] | None] = []
+        self._entries: list[ResolvedSig | None] = []
         self._host_items: list[tuple[int, PubKey, bytes, bytes]] = []
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
-        if pub_key.TYPE == "ed25519":
-            self._items.append((pub_key.bytes(), msg, sig))
+        if pub_key.TYPE in ("ed25519", "sr25519"):
+            self._entries.append(resolve(pub_key, msg, sig))
         else:
-            self._host_items.append((len(self._items), pub_key, msg, sig))
-            self._items.append(None)
+            self._host_items.append((len(self._entries), pub_key, msg, sig))
+            self._entries.append(None)
 
     def verify(self) -> tuple[bool, list[bool]]:
-        device_idx = [i for i, it in enumerate(self._items) if it is not None]
-        device_items = [self._items[i] for i in device_idx]
-        results = [False] * len(self._items)
-        if device_items:
-            bitmap = verify_batch(device_items)
-            for i, ok in zip(device_idx, bitmap):
+        results = [False] * len(self._entries)
+        if any(e is not None for e in self._entries):
+            bitmap = verify_resolved(self._entries)
+            for i, ok in enumerate(bitmap):
                 results[i] = bool(ok)
         for i, pk, msg, sig in self._host_items:
             results[i] = pk.verify_signature(msg, sig)

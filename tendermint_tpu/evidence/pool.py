@@ -16,6 +16,7 @@ import logging
 from ..store.db import DB
 from ..types.evidence import (
     DuplicateVoteEvidence,
+    LightClientAttackEvidence,
     decode_evidence,
 )
 from ..types.keys import SignedMsgType
@@ -92,9 +93,9 @@ class EvidencePool(EvidencePoolI):
 
         if isinstance(ev, DuplicateVoteEvidence):
             self._verify_duplicate_vote(ev, meta.header.time_ns)
+        elif isinstance(ev, LightClientAttackEvidence):
+            self._verify_light_client_attack(ev, meta.header.time_ns)
         else:
-            # light-client attack evidence verification arrives with the
-            # light client (reference verify.go:159)
             raise EvidenceError(f"unsupported evidence type {type(ev).__name__}")
 
     def _verify_duplicate_vote(self, ev: DuplicateVoteEvidence, block_time_ns: int) -> None:
@@ -119,6 +120,89 @@ class EvidencePool(EvidencePoolI):
         for vote in (ev.vote_a, ev.vote_b):
             if not vote.verify(chain_id, val.pub_key):
                 raise EvidenceError("invalid signature on evidence vote")
+
+    def _verify_light_client_attack(
+        self, ev: LightClientAttackEvidence, common_block_time_ns: int
+    ) -> None:
+        """Reference verify.go:159 VerifyLightClientAttack:
+        1. the conflicting block must be properly signed — by 1/3+ of the
+           common-height validator set when the attack skips heights
+           (VerifyCommitLightTrusting), or carry the exact common-height
+           validator hash when adjacent;
+        2. AND by +2/3 of its own claimed validator set (VerifyCommitLight
+           — this funnels into the TPU batch path, verify.go:176);
+        3. the header must actually conflict with the block we committed;
+        4. attribution/power/time fields must match what this node derives."""
+        from fractions import Fraction
+
+        from ..types.validation import (
+            InvalidCommitError,
+            verify_commit_light,
+            verify_commit_light_trusting,
+        )
+
+        ev.validate_basic()
+        chain_id = self.state.chain_id
+        common_vals = self.state_store.load_validators(ev.common_height)
+        if common_vals is None:
+            raise EvidenceError(
+                f"no validator set at common height {ev.common_height}"
+            )
+        conflicting = ev.conflicting_block
+        sh = conflicting.signed_header
+        try:
+            if ev.common_height != conflicting.height:
+                # skipping attack: 1/3 of the common set must have signed
+                verify_commit_light_trusting(
+                    chain_id, common_vals, sh.commit, Fraction(1, 3)
+                )
+            else:
+                if conflicting.header.validators_hash != common_vals.hash():
+                    raise EvidenceError(
+                        "adjacent attack: conflicting header carries a "
+                        "different validator set than the common height"
+                    )
+            verify_commit_light(
+                chain_id,
+                conflicting.validators,
+                sh.commit.block_id,
+                conflicting.height,
+                sh.commit,
+            )
+        except InvalidCommitError as e:
+            raise EvidenceError(f"conflicting block not properly signed: {e}") from e
+
+        # must actually conflict with what we committed at that height
+        trusted_meta = self.block_store.load_block_meta(conflicting.height)
+        if trusted_meta is None:
+            raise EvidenceError(
+                f"no committed block at conflicting height {conflicting.height}"
+            )
+        if trusted_meta.header.hash() == conflicting.header.hash():
+            raise EvidenceError("conflicting header matches the committed one")
+
+        # attribution and the snapshot fields must match our own derivation
+        trusted_commit = self.block_store.load_block_commit(conflicting.height)
+        if trusted_commit is None:
+            # canonical commit for H is stored with block H+1 — at the
+            # store tip only the seen-commit exists
+            trusted_commit = self.block_store.load_seen_commit(conflicting.height)
+        if trusted_commit is None:
+            raise EvidenceError(
+                f"no commit for conflicting height {conflicting.height}"
+            )
+        from ..light.types import SignedHeader
+
+        trusted_sh = SignedHeader(trusted_meta.header, trusted_commit)
+        expect_byz = ev.get_byzantine_validators(common_vals, trusted_sh)
+        if [v.address for v in ev.byzantine_validators] != [
+            v.address for v in expect_byz
+        ]:
+            raise EvidenceError("byzantine validator attribution mismatch")
+        if ev.total_voting_power != common_vals.total_voting_power():
+            raise EvidenceError("evidence total power mismatch")
+        if ev.timestamp_ns != common_block_time_ns:
+            raise EvidenceError("evidence timestamp != common block time")
 
     # -- proposal / block flow ------------------------------------------
 

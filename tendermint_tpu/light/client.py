@@ -150,7 +150,7 @@ class LightClient:
             verified = await self._verify_sequential(latest, target, now_ns, pending)
         else:
             verified = await self._verify_skipping(latest, target, now_ns, pending)
-        await self._detect_divergence(verified, now_ns)
+        await self._detect_divergence(verified, now_ns, trust_anchor=latest)
         for lb in pending:
             self.store.save(lb)
         self.store.save(verified)
@@ -239,11 +239,19 @@ class LightClient:
 
     # -- witness cross-check --------------------------------------------
 
-    async def _detect_divergence(self, verified: LightBlock, now_ns: int) -> None:
+    async def _detect_divergence(
+        self,
+        verified: LightBlock,
+        now_ns: int,
+        trust_anchor: LightBlock | None = None,
+    ) -> None:
         """Compare the newly verified header against every witness
         (reference detector.go:28 detectDivergence). A witness that
         serves a DIFFERENT header for the same height with a valid
-        commit is evidence of an attack."""
+        commit is evidence of an attack: LightClientAttackEvidence is
+        formed against the divergent chain and submitted to the primary
+        and every witness (detector.go:215 newLightClientAttackEvidence),
+        whose evidence pools verify and gossip it toward block inclusion."""
         if not self.witnesses:
             return
         for witness in list(self.witnesses):
@@ -270,4 +278,85 @@ class LightClient:
                 self.logger.info("dropping bad witness %r", witness)
                 self.witnesses.remove(witness)
                 continue
+            await self._report_attack(verified, w_lb, trust_anchor, witness)
             raise Divergence(witness, [verified], w_lb)
+
+    async def _report_attack(
+        self,
+        verified: LightBlock,
+        conflicting: LightBlock,
+        trust_anchor: LightBlock | None,
+        witness: Provider,
+    ) -> None:
+        """Form LightClientAttackEvidence and submit it to every provider
+        (reference detector.go:215). The common height is the last height
+        both chains agreed at — the anchor this update verified from."""
+        from ..types.evidence import LightClientAttackEvidence
+
+        anchor = trust_anchor or self.store.latest()
+        if anchor is None:
+            return
+        if anchor.height > conflicting.height:
+            # backwards verification: the trust anchor sits ABOVE the
+            # conflicting height, so no common ancestor height is known —
+            # evidence built from it would fail validate_basic everywhere
+            self.logger.warning(
+                "divergence below trust anchor (%d > %d): no evidence formed",
+                anchor.height,
+                conflicting.height,
+            )
+            return
+        import dataclasses
+
+        def build(conflicting_lb: LightBlock, trusted_sh) -> object | None:
+            try:
+                ev = LightClientAttackEvidence(
+                    conflicting_block=conflicting_lb,
+                    common_height=anchor.height,
+                    byzantine_validators=(),
+                    total_voting_power=anchor.validators.total_voting_power(),
+                    timestamp_ns=anchor.header.time_ns,
+                )
+                return dataclasses.replace(
+                    ev,
+                    byzantine_validators=tuple(
+                        ev.get_byzantine_validators(anchor.validators, trusted_sh)
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 — must not mask Divergence
+                self.logger.error("failed to build attack evidence: %r", e)
+                return None
+
+        # The client cannot know which side forged, so evidence is formed
+        # in BOTH directions (reference detector.go handles primary- and
+        # witness-side attacks): against the witness's block for the
+        # primary's chain, and against the primary's block for the
+        # witness's chain — each pool keeps only the one that actually
+        # conflicts with its committed header.
+        against_witness = build(conflicting, verified.signed_header)
+        against_primary = build(verified, conflicting.signed_header)
+        targets = []
+        if against_witness is not None:
+            targets += [
+                (p, against_witness)
+                for p in [self.primary, *self.witnesses]
+                if p is not witness
+            ]
+        if against_primary is not None:
+            targets += [
+                (p, against_primary)
+                for p in self.witnesses
+                if p is not self.primary
+            ]
+        for provider, ev in targets:
+            try:
+                await provider.report_evidence(ev)
+                self.logger.info(
+                    "reported light-client attack (common height %d) to %r",
+                    anchor.height,
+                    provider,
+                )
+            except Exception as e:  # noqa: BLE001
+                self.logger.warning(
+                    "failed to report evidence to %r: %r", provider, e
+                )

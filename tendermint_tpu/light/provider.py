@@ -35,10 +35,11 @@ class Provider:
 class BlockStoreProvider(Provider):
     """Serve light blocks straight from a block store + state store."""
 
-    def __init__(self, chain_id: str, block_store, state_store):
+    def __init__(self, chain_id: str, block_store, state_store, evidence_pool=None):
         self._chain_id = chain_id
         self.block_store = block_store
         self.state_store = state_store
+        self.evidence_pool = evidence_pool
         self.reported: list = []
 
     def chain_id(self) -> str:
@@ -60,4 +61,8 @@ class BlockStoreProvider(Provider):
         return self._light_block_sync(height)
 
     async def report_evidence(self, evidence) -> None:
+        """Hand reported evidence to the backing node's pool (the
+        in-process analog of the RPC provider's broadcast_evidence)."""
         self.reported.append(evidence)
+        if self.evidence_pool is not None:
+            self.evidence_pool.add_evidence(evidence)

@@ -1,0 +1,191 @@
+"""Light-client RPC proxy (reference light/proxy/proxy.go:18 +
+light/rpc/client.go): serves the standard JSON-RPC surface, but every
+header-shaped answer is LIGHT-VERIFIED before it leaves, and abci_query
+results are checked against a verified header's app_hash through merkle
+proof operators (crypto/merkle.py ProofOperators). A caller can point any
+normal RPC client at the proxy and get verified answers from an untrusted
+full node.
+
+The env object plugs straight into rpc/server.RPCServer — it implements
+the same route-method protocol as rpc/core.Environment, raising RPCError
+for the routes a stateless proxy cannot serve (tx indexing, consensus
+introspection)."""
+
+from __future__ import annotations
+
+import logging
+
+from ..crypto import merkle
+from ..rpc.core import RPCError
+from .client import LightClient
+
+_UNSUPPORTED = (
+    "net_info",
+    "consensus_state",
+    "block_results",
+    "unconfirmed_txs",
+    "num_unconfirmed_txs",
+    "check_tx",
+    "tx",
+    "tx_search",
+    "block_search",
+    "blockchain",
+    "block_by_hash",
+    "broadcast_evidence",
+    "genesis",
+    "consensus_params",
+)
+
+
+class LightProxyEnv:
+    def __init__(
+        self,
+        light_client: LightClient,
+        primary_rpc,  # rpc.client.HTTPClient against the primary
+        *,
+        logger: logging.Logger | None = None,
+    ):
+        self.lc = light_client
+        self.primary = primary_rpc
+        self.logger = logger or logging.getLogger("light.proxy")
+        self.metrics = None
+
+        for name in _UNSUPPORTED:
+            setattr(self, name, self._unsupported(name))
+
+    @staticmethod
+    def _unsupported(name: str):
+        async def handler(**_kw):
+            raise RPCError(
+                -32601, f"{name} is not served by the light proxy (stateless)"
+            )
+
+        return handler
+
+    async def health(self) -> dict:
+        return {}
+
+    async def _wait_for_height(self, height: int, timeout: float = 10.0) -> None:
+        import asyncio
+
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            st = await self.primary.status()
+            if int(st["sync_info"]["latest_block_height"]) >= height:
+                return
+            if asyncio.get_running_loop().time() > deadline:
+                raise RPCError(
+                    -32000, f"primary never reached height {height} for proof"
+                )
+            await asyncio.sleep(0.1)
+
+    async def status(self) -> dict:
+        res = await self.primary.status()
+        latest = self.lc.store.latest()
+        if latest is not None:
+            # overwrite the untrusted node's claims with verified facts
+            res.setdefault("sync_info", {})
+            res["sync_info"]["trusted_height"] = str(latest.height)
+            res["sync_info"]["trusted_hash"] = latest.header.hash().hex()
+        return res
+
+    async def commit(self, height: int | None = None) -> dict:
+        lb = await self.lc.verify_light_block_at_height(int(height or 0))
+        from ..rpc.core import _commit_json, _header_json
+
+        return {
+            "signed_header": {
+                "header": _header_json(lb.header),
+                "commit": _commit_json(lb.signed_header.commit),
+            },
+            "canonical": True,
+        }
+
+    async def header(self, height: int | None = None) -> dict:
+        lb = await self.lc.verify_light_block_at_height(int(height or 0))
+        from ..rpc.core import _header_json
+
+        return {"header": _header_json(lb.header)}
+
+    async def validators(
+        self, height: int | None = None, page: int = 1, per_page: int = 100
+    ) -> dict:
+        lb = await self.lc.verify_light_block_at_height(int(height or 0))
+        from ..rpc.core import _validator_json
+
+        vals = lb.validators.validators
+        page, per_page = max(1, int(page)), min(int(per_page), 100)
+        start = (page - 1) * per_page
+        return {
+            "block_height": str(lb.height),
+            "validators": [_validator_json(v) for v in vals[start : start + per_page]],
+            "count": str(len(vals[start : start + per_page])),
+            "total": str(len(vals)),
+        }
+
+    async def block(self, height: int | None = None) -> dict:
+        """Fetch the full block from the primary, then require its header
+        to hash to the light-verified header (light/rpc/client.go Block)."""
+        res = await self.primary.block(height=height)
+        got_height = int(res["block"]["header"]["height"])
+        lb = await self.lc.verify_light_block_at_height(got_height)
+        got_hash = bytes.fromhex(res["block_id"]["hash"])
+        if got_hash != lb.header.hash():
+            raise RPCError(
+                -32000,
+                f"primary served block {got_height} with hash "
+                f"{got_hash.hex()} != verified {lb.header.hash().hex()}",
+            )
+        return res
+
+    async def broadcast_tx_async(self, tx: str) -> dict:
+        return await self.primary.call("broadcast_tx_async", tx=tx)
+
+    async def broadcast_tx_sync(self, tx: str) -> dict:
+        return await self.primary.call("broadcast_tx_sync", tx=tx)
+
+    async def broadcast_tx_commit(self, tx: str) -> dict:
+        return await self.primary.call("broadcast_tx_commit", tx=tx)
+
+    async def abci_info(self) -> dict:
+        return await self.primary.call("abci_info")
+
+    async def abci_query(
+        self, path: str = "", data: str = "", height: int = 0, prove: bool = True
+    ) -> dict:
+        """Forward with prove=true, then verify the value against the
+        app_hash of the header at query-height+1 (the app hash produced by
+        executing block H lands in header H+1) — reference
+        light/rpc/client.go ABCIQueryWithOptions."""
+        res = await self.primary.call(
+            "abci_query", path=path, data=data, height=int(height), prove=True
+        )
+        resp = res["response"]
+        if int(resp.get("code", 0)) != 0:
+            return res  # app-level miss; nothing to verify
+        q_height = int(resp["height"])
+        ops = [
+            merkle.ProofOp(
+                o["type"], bytes.fromhex(o["key"]), bytes.fromhex(o["data"])
+            )
+            for o in resp.get("proof_ops", {}).get("ops", [])
+        ]
+        if not ops:
+            raise RPCError(-32000, "primary returned no proof for abci_query")
+        # the app hash covering state at q_height lands in header
+        # q_height+1 — which may not exist yet at the instant of the query
+        # (reference light/rpc/client.go WaitForHeight before verifying)
+        await self._wait_for_height(q_height + 1)
+        lb = await self.lc.verify_light_block_at_height(q_height + 1)
+        value = bytes.fromhex(resp["value"])
+        keypath = merkle.key_path(bytes.fromhex(resp["key"]))
+        if not merkle.ProofOperators(ops).verify_value(
+            lb.header.app_hash, keypath, value
+        ):
+            raise RPCError(
+                -32000,
+                f"abci_query proof verification FAILED against app hash at "
+                f"height {q_height + 1}",
+            )
+        resp["proof_verified"] = True
+        return res

@@ -78,6 +78,12 @@ class NodeConfig:
     # RPC listen address, e.g. "127.0.0.1:26657"; empty disables RPC
     rpc_laddr: str = ""
     tx_index: bool = True
+    # seed mode (reference node/node.go:490 makeSeedNode): run ONLY the
+    # p2p layer + PEX crawler, serving addresses and hanging up — no app,
+    # no consensus, no stores beyond the address book
+    seed_mode: bool = False
+    # persistent address book path; empty keeps addresses in memory only
+    addr_book_path: str = ""
 
 
 class Node(Service):
@@ -117,7 +123,12 @@ class Node(Service):
             network=genesis.chain_id,
             moniker=config.moniker or self.node_id[:8],
         )
-        self.peer_manager = PeerManager(self.node_id)
+        addr_book = None
+        if config.addr_book_path:
+            from .p2p.addrbook import AddressBook
+
+            addr_book = AddressBook(config.addr_book_path)
+        self.peer_manager = PeerManager(self.node_id, addr_book=addr_book)
         self.router = Router(
             self.node_info, self.node_key, self.peer_manager, transports
         )
@@ -192,6 +203,17 @@ class Node(Service):
     # -- lifecycle -------------------------------------------------------
 
     async def on_start(self) -> None:
+        if self.config.seed_mode:
+            # seed nodes never touch the app or stores: router + PEX only
+            self.pex_reactor = PexReactor(
+                self.peer_manager,
+                self.pex_ch,
+                self.peer_manager.subscribe(),
+                seed_mode=True,
+            )
+            await self.router.start()
+            await self.pex_reactor.start()
+            return
         await self.app_conns.start()
         state = self.state_store.load()
         if state is None:
@@ -434,7 +456,9 @@ class Node(Service):
                     await svc.stop()
                 except Exception:
                     pass
-        await self.app_conns.stop()
+        self.peer_manager.save_addr_book()
+        if not self.config.seed_mode:
+            await self.app_conns.stop()
 
     # -- convenience -----------------------------------------------------
 

@@ -38,6 +38,7 @@ class _PeerInfo:
     last_dial_failure: float = 0.0
     connected: bool = False
     inbound: bool = False
+    ever_connected: bool = False  # "good" marker persisted in the book
 
 
 class PeerManager:
@@ -49,6 +50,7 @@ class PeerManager:
         max_connected_upper: int = 24,  # accept surplus before evicting
         min_retry_time: float = 0.25,
         max_retry_time: float = 30.0,
+        addr_book=None,
         logger: logging.Logger | None = None,
     ):
         self.self_id = self_id
@@ -60,6 +62,47 @@ class PeerManager:
         self._peers: dict[NodeID, _PeerInfo] = {}
         self._subscribers: list[asyncio.Queue] = []
         self._dial_wake = asyncio.Event()
+        # optional persistence (p2p/addrbook.py): addresses learned via
+        # PEX survive restarts (reference pex/addrbook.go)
+        self.addr_book = addr_book
+        self._book_loading = False
+        if addr_book is not None:
+            # suppress saves while restoring: a mid-load save would
+            # truncate the on-disk book to the entries loaded so far
+            self._book_loading = True
+            try:
+                for rec in addr_book.load():
+                    self.add_address(rec["address"], persistent=rec["persistent"])
+                    if rec["good"]:
+                        info = self._peers.get(rec["address"].node_id)
+                        if info is not None:
+                            info.ever_connected = True
+            finally:
+                self._book_loading = False
+
+    def _book_entries(self) -> list[dict]:
+        out = []
+        for info in self._peers.values():
+            for addr in info.addresses.values():
+                out.append(
+                    {
+                        "address": addr,
+                        "persistent": info.persistent,
+                        "good": getattr(info, "ever_connected", False),
+                        "attempts": info.dial_failures,
+                    }
+                )
+        return out
+
+    def _book_touch(self) -> None:
+        if self.addr_book is not None and not self._book_loading:
+            self.addr_book.mark_dirty()
+            self.addr_book.maybe_save(self._book_entries)
+
+    def save_addr_book(self) -> None:
+        """Force a synchronous write (shutdown path)."""
+        if self.addr_book is not None:
+            self.addr_book.save(self._book_entries())
 
     # -- address book ----------------------------------------------------
 
@@ -70,6 +113,7 @@ class PeerManager:
         info.addresses[str(address)] = address
         info.persistent = info.persistent or persistent
         self._dial_wake.set()
+        self._book_touch()
         return True
 
     def addresses(self, node_id: NodeID) -> list[NodeAddress]:
@@ -150,7 +194,9 @@ class PeerManager:
         info.inbound = inbound
         info.dial_failures = 0
         info.score += 1
+        info.ever_connected = True
         self._notify(PeerUpdate(node_id, PeerStatus.UP))
+        self._book_touch()
         return True
 
     def disconnected(self, node_id: NodeID) -> None:

@@ -65,6 +65,8 @@ class PexReactor(Service):
         channel: Channel,
         peer_updates: asyncio.Queue,
         *,
+        seed_mode: bool = False,
+        seed_disconnect_after: float = 3.0,
         logger: logging.Logger | None = None,
     ):
         super().__init__("pex", logger)
@@ -72,6 +74,12 @@ class PexReactor(Service):
         self.channel = channel
         self.peer_updates = peer_updates
         self.peers: list[str] = []
+        # seed mode (reference node/node.go:490 makeSeedNode): the node
+        # exists only to crawl and serve addresses — on connect it pushes
+        # its address book at the peer, then hangs up shortly after, so
+        # its connection slots keep turning over
+        self.seed_mode = seed_mode
+        self.seed_disconnect_after = seed_disconnect_after
 
     async def on_start(self) -> None:
         self.spawn(self._process_peer_updates(), name="pex.peers")
@@ -84,8 +92,31 @@ class PexReactor(Service):
             if upd.status == PeerStatus.UP:
                 if upd.node_id not in self.peers:
                     self.peers.append(upd.node_id)
+                if self.seed_mode:
+                    self.spawn(
+                        self._seed_serve(upd.node_id),
+                        name=f"pex.seed.{upd.node_id[:8]}",
+                    )
             elif upd.node_id in self.peers:
                 self.peers.remove(upd.node_id)
+
+    async def _seed_serve(self, node_id: str) -> None:
+        """Push addresses at a fresh peer, then disconnect it."""
+        import asyncio as _a
+
+        known = self.peer_manager.all_known()[:MAX_ADDRESSES]
+        addrs = tuple(str(a) for a in known if a.node_id != node_id)
+        try:
+            self.channel.out_q.put_nowait(
+                Envelope(PEX_CHANNEL, PexResponse(addrs), to=node_id)
+            )
+        except _a.QueueFull:
+            pass
+        await _a.sleep(self.seed_disconnect_after)
+        if node_id in self.peers:
+            await self.channel.error(
+                PeerError(node_id, "seed: address exchange complete")
+            )
 
     async def _process_inbound(self) -> None:
         async for env in self.channel:

@@ -26,12 +26,29 @@ PING_INTERVAL = 30.0
 
 
 class TCPConnection(Connection):
-    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        *,
+        send_rate: int = 0,
+        recv_rate: int = 0,
+    ):
         self._stream = SecretStream(reader, writer)
         self._writer = writer
         self._send_lock = asyncio.Lock()
         self._closed = False
         self._ping_task: asyncio.Task | None = None
+        # flow-rate limiting (reference conn/connection.go:122-150 via
+        # internal/libs/flowrate): senders BLOCK at the configured rate —
+        # backpressure propagates to the router's per-peer queue instead
+        # of silently dropping consensus messages at a full queue
+        from ..libs.flowrate import Meter, RateLimiter
+
+        self._send_limiter = RateLimiter(send_rate) if send_rate else None
+        self._recv_limiter = RateLimiter(recv_rate) if recv_rate else None
+        self.send_meter = Meter()
+        self.recv_meter = Meter()
 
     async def handshake(self, node_info: NodeInfo, priv_key) -> NodeInfo:
         peer_key = await self._stream.handshake(priv_key)
@@ -73,10 +90,13 @@ class TCPConnection(Connection):
     async def send_message(self, channel_id: int, data: bytes) -> None:
         if self._closed:
             raise ConnectionClosedError("connection closed")
+        if self._send_limiter is not None:
+            await self._send_limiter.throttle(len(data) + 6)
         try:
             await self._send_raw(_T_DATA, channel_id, data)
         except (ConnectionError, asyncio.IncompleteReadError, OSError) as e:
             raise ConnectionClosedError(str(e)) from e
+        self.send_meter.update(len(data) + 6)
 
     async def receive_message(self) -> tuple[int, bytes]:
         while True:
@@ -86,6 +106,12 @@ class TCPConnection(Connection):
                 t, ch, payload = await self._recv_raw()
             except (asyncio.IncompleteReadError, ConnectionError, OSError) as e:
                 raise ConnectionClosedError(str(e)) from e
+            if self._recv_limiter is not None:
+                # reading slower is the only honest receive throttle TCP
+                # offers: the kernel buffer fills and the peer's sender
+                # blocks on ITS limiter
+                await self._recv_limiter.throttle(len(payload) + 6)
+            self.recv_meter.update(len(payload) + 6)
             if t == _T_DATA:
                 return ch, payload
             if t == _T_PING:
@@ -110,10 +136,12 @@ class TCPConnection(Connection):
 class TCPTransport(Transport):
     PROTOCOL = "tcp"
 
-    def __init__(self):
+    def __init__(self, *, send_rate: int = 0, recv_rate: int = 0):
         self._server: asyncio.AbstractServer | None = None
         self._accept_q: asyncio.Queue[TCPConnection | None] = asyncio.Queue(64)
         self._endpoint: str | None = None
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
 
     async def listen(self, endpoint: str) -> None:
         host, _, port = endpoint.rpartition(":")
@@ -127,7 +155,11 @@ class TCPTransport(Transport):
     async def _on_client(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        await self._accept_q.put(TCPConnection(reader, writer))
+        await self._accept_q.put(
+            TCPConnection(
+                reader, writer, send_rate=self.send_rate, recv_rate=self.recv_rate
+            )
+        )
 
     def endpoint(self) -> str | None:
         return self._endpoint
@@ -140,7 +172,9 @@ class TCPTransport(Transport):
 
     async def dial(self, address: NodeAddress) -> Connection:
         reader, writer = await asyncio.open_connection(address.host, address.port)
-        return TCPConnection(reader, writer)
+        return TCPConnection(
+            reader, writer, send_rate=self.send_rate, recv_rate=self.recv_rate
+        )
 
     async def close(self) -> None:
         if self._server is not None:

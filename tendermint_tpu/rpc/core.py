@@ -490,6 +490,16 @@ class Environment:
                 "key": res.key.hex(),
                 "value": res.value.hex(),
                 "height": str(res.height),
+                "proof_ops": {
+                    "ops": [
+                        {
+                            "type": op.type_,
+                            "key": op.key.hex(),
+                            "data": op.data.hex(),
+                        }
+                        for op in res.proof_ops
+                    ]
+                },
             }
         }
 

@@ -51,3 +51,9 @@ def pytest_pyfunc_call(pyfuncitem):
         asyncio.run(func(**kwargs))
         return True
     return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running multi-process e2e tests"
+    )

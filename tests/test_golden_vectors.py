@@ -1,0 +1,110 @@
+"""Golden-vector freeze of the consensus-critical byte formats.
+
+The bespoke deterministic codec (libs/protoenc.py + types/canonical.py)
+defines sign-bytes and hashes — consensus-critical bytes with no protobuf
+schema pinning them. These vectors freeze the CURRENT wire format: any
+refactor that silently reorders a dataclass field or changes a tag now
+fails here instead of hard-forking a running network (the reference
+freezes the same surface with generated protobuf + types/canonical.go:56;
+its own golden tests live in types/*_test.go).
+
+If a vector changes INTENTIONALLY (a deliberate wire format revision),
+update it here in the same commit and call the break out loudly.
+"""
+
+from tendermint_tpu.crypto.hashes import sha256
+from tendermint_tpu.types.block import (
+    BlockID,
+    Commit,
+    CommitSig,
+    Header,
+    PartSetHeader,
+)
+from tendermint_tpu.types.canonical import proposal_sign_bytes, vote_sign_bytes
+from tendermint_tpu.types.keys import SignedMsgType
+
+BID = BlockID(bytes(range(32)), PartSetHeader(3, bytes(range(32, 64))))
+
+
+class TestSignBytesVectors:
+    def test_precommit_sign_bytes(self):
+        sb = vote_sign_bytes(
+            "golden-chain",
+            SignedMsgType.PRECOMMIT,
+            12345,
+            2,
+            BID,
+            1_700_000_000_123_456_789,
+        )
+        assert sb.hex() == (
+            "79080211393000000000000019020000000000000022480a200001020304050607"
+            "08090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f1224080312202021"
+            "22232425262728292a2b2c2d2e2f303132333435363738393a3b3c3d3e3f2a0b"
+            "0880e2cfaa0610959aef3a320c676f6c64656e2d636861696e"
+        )
+
+    def test_nil_prevote_sign_bytes(self):
+        sb = vote_sign_bytes("golden-chain", SignedMsgType.PREVOTE, 1, 0, BlockID(), 0)
+        assert sb.hex() == "1b08011101000000000000002a00320c676f6c64656e2d636861696e"
+
+    def test_sign_bytes_sensitivity(self):
+        """Every field must perturb the bytes (catches a dropped field)."""
+        base = vote_sign_bytes(
+            "c", SignedMsgType.PRECOMMIT, 5, 1, BID, 1000
+        )
+        variants = [
+            vote_sign_bytes("d", SignedMsgType.PRECOMMIT, 5, 1, BID, 1000),
+            vote_sign_bytes("c", SignedMsgType.PREVOTE, 5, 1, BID, 1000),
+            vote_sign_bytes("c", SignedMsgType.PRECOMMIT, 6, 1, BID, 1000),
+            vote_sign_bytes("c", SignedMsgType.PRECOMMIT, 5, 2, BID, 1000),
+            vote_sign_bytes("c", SignedMsgType.PRECOMMIT, 5, 1, BlockID(), 1000),
+            vote_sign_bytes("c", SignedMsgType.PRECOMMIT, 5, 1, BID, 1001),
+        ]
+        assert len({base, *variants}) == 7
+
+    def test_proposal_sign_bytes_stable(self):
+        sb = proposal_sign_bytes("golden-chain", 9, 1, -1, BID, 777)
+        # structural freeze: length-prefixed, chain id trailing
+        assert sb.endswith(b"golden-chain")
+        assert sb == proposal_sign_bytes("golden-chain", 9, 1, -1, BID, 777)
+
+
+class TestHashVectors:
+    def test_header_hash(self):
+        hdr = Header(
+            chain_id="golden-chain",
+            height=7,
+            time_ns=1_700_000_000_000_000_001,
+            last_block_id=BID,
+            last_commit_hash=sha256(b"lc"),
+            data_hash=sha256(b"d"),
+            validators_hash=sha256(b"v"),
+            next_validators_hash=sha256(b"nv"),
+            consensus_hash=sha256(b"c"),
+            app_hash=sha256(b"a"),
+            last_results_hash=sha256(b"r"),
+            evidence_hash=b"",
+            proposer_address=b"\x11" * 20,
+        )
+        assert hdr.hash().hex() == (
+            "5b763475895b7f93e69f7a603ab2e4cc9fe6ce521370cf9d7d792cb3e1578809"
+        )
+
+    def test_commit_encoding(self):
+        commit = Commit(
+            7,
+            1,
+            BID,
+            (
+                CommitSig.for_block(
+                    b"\x22" * 20, 1_700_000_000_000_000_002, b"\x33" * 64
+                ),
+                CommitSig.absent(),
+            ),
+        )
+        enc = commit.encode()
+        assert len(enc) == 200
+        assert sha256(enc).hex() == (
+            "d6d0c69441fb46a0b7377e81d0bcc81c425c8cf4af6202c391eec6089ee3a0c5"
+        )
+        assert Commit.decode(enc).encode() == enc

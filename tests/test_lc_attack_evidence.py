@@ -155,3 +155,100 @@ class TestCodecAndValidation:
         )
         with pytest.raises(ValueError):
             beyond.validate_basic()
+
+
+class TestEndToEnd:
+    @pytest.mark.asyncio
+    async def test_forged_witness_header_becomes_block_evidence(self):
+        """Full pipeline (reference light/detector.go:215 +
+        internal/evidence/verify.go:159): a witness serves a forged header
+        -> light client forms LightClientAttackEvidence and reports it to
+        the primary -> the node's evidence pool verifies it -> consensus
+        commits it in a block."""
+        import asyncio
+        from dataclasses import replace as drep
+
+        from tendermint_tpu.consensus.harness import LocalNetwork
+        from tendermint_tpu.light.client import (
+            Divergence,
+            LightClient,
+            TrustOptions,
+            TrustedStore,
+        )
+        from tendermint_tpu.light.provider import BlockStoreProvider
+        from tendermint_tpu.light.types import LightBlock as LB, SignedHeader as SH
+        from tendermint_tpu.testing import make_commit
+        from tendermint_tpu.types.block import BlockID as BID, PartSetHeader as PSH
+
+        net = LocalNetwork(3)
+        await net.start()
+        try:
+            await net.wait_for_height(5, timeout=60)
+            node = net.nodes[0]
+            primary = BlockStoreProvider(
+                net.genesis.chain_id,
+                node.block_store,
+                node.state_store,
+                evidence_pool=node.evidence_pool,
+            )
+            target = 4
+
+            class ForgingWitness:
+                def __init__(self, base):
+                    self.base = base
+
+                async def light_block(self, height):
+                    lb = await self.base.light_block(height)
+                    if height != target:
+                        return lb
+                    hdr = drep(lb.header, data_hash=b"\xdd" * 32)
+                    keys = {k.pub_key().address(): k for k in net.keys}
+                    bid = BID(hdr.hash(), PSH(1, b"\x02" * 32))
+                    commit = make_commit(
+                        net.genesis.chain_id, height, 0, bid, lb.validators, keys
+                    )
+                    return LB(SH(hdr, commit), lb.validators)
+
+                async def report_evidence(self, evidence):
+                    pass
+
+                def __repr__(self):
+                    return "ForgingWitness"
+
+            lb1 = await primary.light_block(1)
+            client = LightClient(
+                net.genesis.chain_id,
+                TrustOptions(period_ns=10**18, height=1, hash=lb1.header.hash()),
+                primary,
+                [ForgingWitness(primary)],
+                store=TrustedStore(),
+                sequential=True,
+            )
+            with pytest.raises(Divergence):
+                await client.verify_light_block_at_height(target)
+
+            # evidence reached the primary's pool and verified
+            assert primary.reported, "no evidence was reported"
+            ev = primary.reported[0]
+            assert isinstance(ev, LightClientAttackEvidence)
+            assert len(ev.byzantine_validators) == 3  # equivocation: all signed
+            pending, _ = node.evidence_pool.pending_evidence(1 << 20)
+            assert any(e.hash() == ev.hash() for e in pending)
+
+            # the running chain commits it into a block
+            deadline = asyncio.get_running_loop().time() + 30
+            committed = None
+            while asyncio.get_running_loop().time() < deadline:
+                for h in range(1, node.block_store.height() + 1):
+                    blk = node.block_store.load_block(h)
+                    if blk and blk.evidence:
+                        committed = (h, blk.evidence)
+                        break
+                if committed:
+                    break
+                await asyncio.sleep(0.2)
+            assert committed, "attack evidence never committed in a block"
+            h, evs = committed
+            assert any(e.hash() == ev.hash() for e in evs)
+        finally:
+            await net.stop()

@@ -1,0 +1,142 @@
+"""Multi-process e2e: four validators as separate OS processes over real
+TCP with a kill+restart perturbation and app-hash convergence assertions
+(reference test/e2e/runner/{main,perturb}.go — containers replaced by
+plain processes; same black-box method: drive and observe over RPC only).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import Config, config_from_toml, config_to_toml
+
+N_VALS = 4
+BASE_PORT = 28600
+
+
+def _rpc(port: int, path: str) -> dict:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/{path}", timeout=5
+    ) as resp:
+        return json.loads(resp.read())["result"]
+
+
+def _spawn(home: str) -> subprocess.Popen:
+    env = dict(
+        os.environ,
+        TMTPU_DISABLE_TPU="1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "from tendermint_tpu.cli import main; import sys; "
+            f"sys.exit(main(['--home', {home!r}, 'start']))",
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,
+    )
+
+
+def _wait_height(port: int, height: int, timeout: float) -> None:
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        try:
+            st = _rpc(port, "status")
+            last = int(st["sync_info"]["latest_block_height"])
+            if last >= height:
+                return
+        except Exception:
+            pass
+        time.sleep(0.5)
+    raise TimeoutError(f"node on :{port} stuck at {last} (wanted {height})")
+
+
+@pytest.mark.slow
+def test_four_process_testnet_with_kill_restart(tmp_path):
+    base = str(tmp_path / "net")
+    rc = cli_main(
+        [
+            "testnet",
+            "--validators",
+            str(N_VALS),
+            "--output",
+            base,
+            "--base-port",
+            str(BASE_PORT),
+        ]
+    )
+    assert rc == 0
+
+    # speed the chain up: rewrite each generated config with test timeouts
+    for i in range(N_VALS):
+        toml_path = os.path.join(base, f"node{i}", "config", "config.toml")
+        with open(toml_path) as f:
+            cfg = config_from_toml(f.read())
+        MS = 1_000_000
+        cfg.consensus.timeout_propose_ns = 1000 * MS
+        cfg.consensus.timeout_prevote_ns = 400 * MS
+        cfg.consensus.timeout_precommit_ns = 400 * MS
+        cfg.consensus.timeout_commit_ns = 300 * MS
+        with open(toml_path, "w") as f:
+            f.write(config_to_toml(cfg))
+
+    rpc_ports = [BASE_PORT + 2 * i + 1 for i in range(N_VALS)]
+    procs: dict[int, subprocess.Popen] = {}
+    try:
+        for i in range(N_VALS):
+            procs[i] = _spawn(os.path.join(base, f"node{i}"))
+
+        # the network must make progress with all 4 up
+        for port in rpc_ports:
+            _wait_height(port, 3, timeout=120)
+
+        # perturbation: SIGKILL validator 3 (reference perturb.go kill)
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+
+        # 3-of-4 keeps committing (2/3+ still online)
+        h_before = int(_rpc(rpc_ports[0], "status")["sync_info"]["latest_block_height"])
+        _wait_height(rpc_ports[0], h_before + 2, timeout=120)
+
+        # restart on the same stores; it must catch up (WAL + handshake +
+        # block-sync recovery path)
+        procs[3] = _spawn(os.path.join(base, "node3"))
+        h_target = int(_rpc(rpc_ports[0], "status")["sync_info"]["latest_block_height"])
+        _wait_height(rpc_ports[3], h_target, timeout=180)
+
+        # app-hash convergence at a common committed height
+        common = min(
+            int(_rpc(p, "status")["sync_info"]["latest_block_height"])
+            for p in rpc_ports
+        )
+        hashes = {
+            _rpc(p, f"block?height={common}")["block"]["header"]["app_hash"]
+            for p in rpc_ports
+        }
+        assert len(hashes) == 1, f"app hash divergence at {common}: {hashes}"
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    p.kill()
+        for p in procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass

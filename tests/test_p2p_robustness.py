@@ -1,0 +1,169 @@
+"""Flow-rate limiting, persistent address book, and seed mode
+(reference internal/libs/flowrate, internal/p2p/pex/addrbook.go,
+node/node.go:490 makeSeedNode)."""
+
+import asyncio
+import os
+import time
+
+import pytest
+
+from tendermint_tpu.libs.flowrate import Meter, RateLimiter
+from tendermint_tpu.p2p.addrbook import AddressBook
+from tendermint_tpu.p2p.peermanager import PeerManager
+from tendermint_tpu.p2p.types import NodeAddress
+
+
+class TestRateLimiter:
+    @pytest.mark.asyncio
+    async def test_throttles_to_rate(self):
+        limiter = RateLimiter(rate=100_000, burst=10_000)
+        t0 = time.monotonic()
+        total = 0
+        for _ in range(10):
+            await limiter.throttle(5_000)
+            total += 5_000
+        dt = time.monotonic() - t0
+        # 50 KB at 100 KB/s with a 10 KB burst: >= ~0.35s
+        assert dt >= 0.3, f"finished too fast: {dt:.3f}s for {total} bytes"
+        assert dt < 1.5, f"over-throttled: {dt:.3f}s"
+
+    @pytest.mark.asyncio
+    async def test_unlimited_passes_through(self):
+        limiter = RateLimiter(rate=0)
+        t0 = time.monotonic()
+        for _ in range(100):
+            await limiter.throttle(10**9)
+        assert time.monotonic() - t0 < 0.1
+
+    @pytest.mark.asyncio
+    async def test_burst_credit(self):
+        limiter = RateLimiter(rate=1_000, burst=50_000)
+        t0 = time.monotonic()
+        await limiter.throttle(40_000)  # within burst: immediate
+        assert time.monotonic() - t0 < 0.05
+
+    def test_meter(self):
+        m = Meter()
+        m.update(1000)
+        assert m.total == 1000
+
+
+class TestTCPFlowRate:
+    @pytest.mark.asyncio
+    async def test_rate_limited_transfer_is_bounded(self):
+        """Two real TCP connections with a 50 KB/s send limit: pushing
+        100 KB must take >= ~1s and nothing is dropped."""
+        from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+        from tendermint_tpu.p2p.tcp import TCPTransport
+        from tendermint_tpu.p2p.types import NodeAddress, NodeInfo
+
+        lt = TCPTransport(send_rate=50_000, recv_rate=0)
+        await lt.listen("127.0.0.1:0")
+        host, _, port = lt.endpoint().rpartition(":")
+        dt_ = TCPTransport(send_rate=50_000, recv_rate=0)
+
+        k1, k2 = Ed25519PrivKey(b"\x01" * 32), Ed25519PrivKey(b"\x02" * 32)
+        from tendermint_tpu.p2p.types import node_id_from_pubkey
+
+        i1 = NodeInfo(node_id=node_id_from_pubkey(k1.pub_key()), network="t", moniker="a")
+        i2 = NodeInfo(node_id=node_id_from_pubkey(k2.pub_key()), network="t", moniker="b")
+
+        dial_task = asyncio.ensure_future(
+            dt_.dial(NodeAddress(node_id=i1.node_id, protocol="tcp", host="127.0.0.1", port=int(port)))
+        )
+        server_conn = await lt.accept()
+        client_conn = await dial_task
+        hs_server = asyncio.ensure_future(server_conn.handshake(i1, k1))
+        await client_conn.handshake(i2, k2)
+        await hs_server
+
+        payload = os.urandom(10_000)
+        n_msgs = 10  # ~100 KB total at 50 KB/s -> >= ~1.5s after burst
+
+        async def recv_all():
+            got = 0
+            while got < n_msgs:
+                _ch, data = await server_conn.receive_message()
+                assert data == payload
+                got += 1
+            return got
+
+        recv_task = asyncio.ensure_future(recv_all())
+        t0 = time.monotonic()
+        for _ in range(n_msgs):
+            await client_conn.send_message(0x21, payload)
+        got = await asyncio.wait_for(recv_task, timeout=20)
+        dt = time.monotonic() - t0
+        assert got == n_msgs  # zero drops under throttling
+        assert dt >= 0.8, f"rate limit not applied: {dt:.2f}s for 100KB at 50KB/s"
+        await client_conn.close()
+        await server_conn.close()
+        await lt.close()
+
+
+class TestAddressBook:
+    def test_roundtrip(self, tmp_path):
+        book = AddressBook(str(tmp_path / "addrbook.json"))
+        addr = NodeAddress(node_id="ab" * 20, protocol="tcp", host="10.0.0.1", port=26656)
+        book.save(
+            [{"address": addr, "persistent": True, "good": True, "attempts": 2}]
+        )
+        loaded = AddressBook(str(tmp_path / "addrbook.json")).load()
+        assert len(loaded) == 1
+        assert str(loaded[0]["address"]) == str(addr)
+        assert loaded[0]["persistent"] and loaded[0]["good"]
+
+    def test_corrupt_file_tolerated(self, tmp_path):
+        path = tmp_path / "addrbook.json"
+        path.write_text("{not json")
+        assert AddressBook(str(path)).load() == []
+
+    def test_peer_manager_persistence(self, tmp_path):
+        path = str(tmp_path / "addrbook.json")
+        pm = PeerManager("ff" * 20, addr_book=AddressBook(path))
+        addr = NodeAddress(node_id="cd" * 20, protocol="tcp", host="10.0.0.2", port=26656)
+        pm.add_address(addr, persistent=True)
+        pm.save_addr_book()
+
+        pm2 = PeerManager("ff" * 20, addr_book=AddressBook(path))
+        known = pm2.all_known()
+        assert [str(a) for a in known] == [str(addr)]
+
+
+class TestSeedMode:
+    @pytest.mark.asyncio
+    async def test_seed_serves_addresses_then_disconnects(self):
+        """A seed-mode PEX reactor pushes its address book at a fresh peer
+        and posts a disconnect error shortly after."""
+        from tendermint_tpu.p2p.pex import (
+            PEX_CHANNEL,
+            PexReactor,
+            PexResponse,
+            encode_message,
+            decode_message,
+        )
+        from tendermint_tpu.p2p.peermanager import PeerStatus, PeerUpdate
+        from tendermint_tpu.p2p.router import Channel
+
+        pm = PeerManager("aa" * 20)
+        pm.add_address(
+            NodeAddress(node_id="bb" * 20, protocol="tcp", host="10.1.1.1", port=1)
+        )
+        ch = Channel(PEX_CHANNEL, "pex", 1, encode_message, decode_message)
+        updates: asyncio.Queue = asyncio.Queue()
+        reactor = PexReactor(
+            pm, ch, updates, seed_mode=True, seed_disconnect_after=0.2
+        )
+        await reactor.start()
+        try:
+            await updates.put(PeerUpdate("cc" * 20, PeerStatus.UP))
+            env = await asyncio.wait_for(ch.out_q.get(), timeout=5)
+            while not isinstance(env.message, PexResponse):
+                env = await asyncio.wait_for(ch.out_q.get(), timeout=5)
+            assert env.to == "cc" * 20
+            assert any("10.1.1.1" in a for a in env.message.addresses)
+            err = await asyncio.wait_for(ch.err_q.get(), timeout=5)
+            assert err.node_id == "cc" * 20
+        finally:
+            await reactor.stop()

@@ -1,0 +1,216 @@
+"""sr25519 (schnorrkel/ristretto255/merlin) tests — reference
+crypto/sr25519/sr25519_test.go plus RFC 9496 ristretto255 test vectors,
+and the mixed-key validator set coverage of crypto/batch/batch.go dispatch.
+"""
+
+import pytest
+
+import tendermint_tpu.crypto.ed25519_math as em
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto import sr25519 as sr
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+
+
+class TestKeccakStrobe:
+    def test_keccak_f1600_vs_hashlib_sha3(self):
+        """Drive a one-block SHA3-256 sponge through our permutation and
+        compare against hashlib — a full known-answer test of keccak-f."""
+        import hashlib
+
+        rate = 136  # SHA3-256 rate in bytes
+        block = bytearray(rate)
+        block[0] ^= 0x06  # SHA3 domain padding
+        block[rate - 1] ^= 0x80
+        lanes = [
+            int.from_bytes(bytes(block[8 * i : 8 * i + 8]), "little")
+            if 8 * i < rate
+            else 0
+            for i in range(25)
+        ]
+        out = sr.keccak_f1600(lanes)
+        digest = b"".join(lane.to_bytes(8, "little") for lane in out)[:32]
+        assert digest == hashlib.sha3_256(b"").digest()
+
+    def test_merlin_transcript_determinism(self):
+        a = sr.MerlinTranscript(b"test")
+        b = sr.MerlinTranscript(b"test")
+        a.append_message(b"l", b"m")
+        b.append_message(b"l", b"m")
+        assert a.challenge_bytes(b"c", 32) == b.challenge_bytes(b"c", 32)
+        # domain separation: different label -> different challenge
+        c = sr.MerlinTranscript(b"test2")
+        c.append_message(b"l", b"m")
+        assert c.challenge_bytes(b"c", 32) != sr.MerlinTranscript(
+            b"test"
+        ).challenge_bytes(b"c", 32)
+
+
+RFC9496_SMALL_MULTIPLES = [
+    "0000000000000000000000000000000000000000000000000000000000000000",
+    "e2f2ae0a6abc4e71a884a961c500515f58e30b6aa582dd8db6a65945e08d2d76",
+    "6a493210f7499cd17fecb510ae0cea23a110e8d5b901f8acadd3095c73a3b919",
+    "94741f5d5d52755ece4f23f044ee27d5d1ea1e2bd196b462166b16152a9d0259",
+    "da80862773358b466ffadfe0b3293ab3d9fd53c5ea6c955358f568322daf6a57",
+    "e882b131016b52c1d3337080187cf768423efccbb517bb495ab812c4160ff44e",
+]
+
+
+class TestRistretto:
+    def test_rfc9496_small_multiples(self):
+        for k, expect in enumerate(RFC9496_SMALL_MULTIPLES):
+            p = em.Point.identity() if k == 0 else em.BASE.scalar_mul(k)
+            assert sr.ristretto_encode(p).hex() == expect
+
+    def test_decode_encode_roundtrip(self):
+        for k in (1, 2, 7, 99, 31337):
+            enc = sr.ristretto_encode(em.BASE.scalar_mul(k))
+            p = sr.ristretto_decode(enc)
+            assert p is not None
+            assert sr.ristretto_encode(p) == enc
+
+    def test_decode_rejects_invalid(self):
+        # non-canonical (>= p)
+        assert sr.ristretto_decode((sr.P + 3).to_bytes(32, "little")) is None
+        # negative s (odd)
+        assert sr.ristretto_decode((3).to_bytes(32, "little")) is None
+        # not on curve / no square root: try a few garbage values
+        bad = 0
+        for v in (8, 10, 12, 14, 16, 18, 20, 22):
+            if sr.ristretto_decode(int(v).to_bytes(32, "little")) is None:
+                bad += 1
+        assert bad > 0
+        assert sr.ristretto_decode(b"\x01" * 31) is None  # wrong length
+
+    def test_torsion_safety(self):
+        """Encodings quotient torsion: P and P+T (T 4-torsion) encode
+        equal — decode must give a representative encoding back to the
+        same bytes."""
+        p = em.BASE.scalar_mul(5)
+        enc = sr.ristretto_encode(p)
+        dec = sr.ristretto_decode(enc)
+        assert dec.mul_by_cofactor().equals(p.mul_by_cofactor())
+
+
+class TestSignVerify:
+    def test_roundtrip(self):
+        priv = sr.Sr25519PrivKey(b"\x07" * 32)
+        pub = priv.pub_key()
+        sig = priv.sign(b"msg")
+        assert len(sig) == 64
+        assert sig[63] & 0x80  # schnorrkel marker
+        assert pub.verify_signature(b"msg", sig)
+        assert not pub.verify_signature(b"msG", sig)
+
+    def test_tamper_rejection(self):
+        priv = sr.Sr25519PrivKey.generate()
+        pub = priv.pub_key()
+        sig = priv.sign(b"payload")
+        for i in (0, 31, 32, 63):
+            bad = bytearray(sig)
+            bad[i] ^= 0x04
+            assert not pub.verify_signature(b"payload", bytes(bad))
+
+    def test_unmarked_signature_rejected(self):
+        priv = sr.Sr25519PrivKey.generate()
+        pub = priv.pub_key()
+        sig = bytearray(priv.sign(b"x"))
+        sig[63] &= 0x7F  # strip the schnorrkel marker
+        assert not pub.verify_signature(b"x", bytes(sig))
+
+    def test_wrong_key(self):
+        a, b = sr.Sr25519PrivKey.generate(), sr.Sr25519PrivKey.generate()
+        sig = a.sign(b"x")
+        assert not b.pub_key().verify_signature(b"x", sig)
+
+    def test_deterministic_signing(self):
+        priv = sr.Sr25519PrivKey(b"\x11" * 32)
+        assert priv.sign(b"m") == priv.sign(b"m")
+        assert priv.sign(b"m") != priv.sign(b"n")
+
+
+class TestBatchDispatch:
+    def test_supports_batch(self):
+        assert crypto_batch.supports_batch_verifier(
+            sr.Sr25519PrivKey.generate().pub_key()
+        )
+        bv = crypto_batch.create_batch_verifier(
+            sr.Sr25519PrivKey.generate().pub_key()
+        )
+        assert bv is not None
+
+    def test_mixed_ed25519_sr25519_batch(self):
+        """One verifier accepts both key types and produces a correct
+        bitmap (TPU disabled in tests -> CPU loop; the TPU path is
+        covered in test_tpu_crypto.py)."""
+        bv = crypto_batch.AdaptiveBatchVerifier()
+        msgs = []
+        for i in range(3):
+            priv = Ed25519PrivKey(bytes([i]) * 32)
+            msg = b"ed-%d" % i
+            bv.add(priv.pub_key(), msg, priv.sign(msg))
+        for i in range(3):
+            priv = sr.Sr25519PrivKey(bytes([0x40 + i]) * 32)
+            msg = b"sr-%d" % i
+            bv.add(priv.pub_key(), msg, priv.sign(msg))
+        ok, bitmap = bv.verify()
+        assert ok and all(bitmap) and len(bitmap) == 6
+
+    def test_mixed_batch_pinpoints_bad_sig(self):
+        bv = crypto_batch.AdaptiveBatchVerifier()
+        ed = Ed25519PrivKey(b"\x01" * 32)
+        srk = sr.Sr25519PrivKey(b"\x02" * 32)
+        bv.add(ed.pub_key(), b"a", ed.sign(b"a"))
+        bv.add(srk.pub_key(), b"b", srk.sign(b"WRONG"))
+        bv.add(srk.pub_key(), b"c", srk.sign(b"c"))
+        ok, bitmap = bv.verify()
+        assert not ok
+        assert bitmap == [True, False, True]
+
+
+class TestMixedCommit:
+    @pytest.mark.asyncio
+    async def test_verify_commit_mixed_keys(self):
+        """A validator set mixing ed25519 and sr25519 keys passes
+        verify_commit (reference: verifyCommitBatch over the sr25519
+        BatchVerifier, crypto/sr25519/batch.go:14-46)."""
+        from tendermint_tpu.testing import make_block_id
+        from tendermint_tpu.types.canonical import vote_sign_bytes
+        from tendermint_tpu.types.block import Commit, CommitSig
+        from tendermint_tpu.types.keys import SignedMsgType
+        from tendermint_tpu.types.validation import (
+            verify_commit,
+            verify_commit_light,
+        )
+        from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+        chain_id = "mixed-chain"
+        keys = [
+            Ed25519PrivKey(b"\x01" * 32),
+            sr.Sr25519PrivKey(b"\x02" * 32),
+            Ed25519PrivKey(b"\x03" * 32),
+            sr.Sr25519PrivKey(b"\x04" * 32),
+        ]
+        vals = ValidatorSet([Validator(k.pub_key(), 10) for k in keys])
+        by_addr = {k.pub_key().address(): k for k in keys}
+        bid = make_block_id(b"mixed")
+        sigs = []
+        for val in vals.validators:
+            sb = vote_sign_bytes(
+                chain_id, SignedMsgType.PRECOMMIT, 5, 0, bid, 1000
+            )
+            sigs.append(
+                CommitSig.for_block(val.address, 1000, by_addr[val.address].sign(sb))
+            )
+        commit = Commit(5, 0, bid, tuple(sigs))
+        verify_commit(chain_id, vals, bid, 5, commit)
+        verify_commit_light(chain_id, vals, bid, 5, commit)
+
+        # a tampered sr25519 signature must fail verification
+        bad_sigs = list(sigs)
+        tampered = bytearray(sigs[1].signature)
+        tampered[2] ^= 1
+        bad_sigs[1] = CommitSig.for_block(
+            vals.validators[1].address, 1000, bytes(tampered)
+        )
+        with pytest.raises(Exception):
+            verify_commit(chain_id, vals, bid, 5, Commit(5, 0, bid, tuple(bad_sigs)))

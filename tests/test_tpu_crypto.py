@@ -194,3 +194,125 @@ def test_tpu_batch_verifier_interface():
     bv2.add(eds[0].pub_key(), b"a", eds[0].sign(b"b"))
     ok, bits = bv2.verify()
     assert not ok and bits == [False]
+
+
+# -- batch-equation (MSM) kernel ---------------------------------------------
+
+
+def _signed_items(n, n_vals=8):
+    from tendermint_tpu import testing as tt
+
+    chain_id = "eq-chain"
+    vals, keys = tt.make_validator_set(n_vals)
+    items = []
+    h = 1
+    while len(items) < n:
+        bid = tt.make_block_id(b"eq%d" % h)
+        c = tt.make_commit(chain_id, h, 0, bid, vals, keys)
+        for i, cs in enumerate(c.signatures):
+            if len(items) >= n:
+                break
+            items.append(
+                (
+                    vals.validators[i].pub_key.bytes(),
+                    c.vote_sign_bytes(chain_id, i),
+                    cs.signature,
+                )
+            )
+        h += 1
+    return items
+
+
+def test_msm_matches_oracle():
+    """MSM over random points/scalars vs the integer oracle."""
+    import numpy as np
+    import tendermint_tpu.crypto.ed25519_math as em
+    from tendermint_tpu.crypto.tpu import curve, field as F, msm
+
+    rng = np.random.default_rng(7)
+    n = 5
+    pts_int = [em.BASE.scalar_mul(int(k)) for k in rng.integers(1, 2**30, n)]
+    scalars = [int.from_bytes(rng.bytes(32), "little") % em.L for _ in range(n)]
+
+    # oracle
+    want = em.Point.identity()
+    for p, s in zip(pts_int, scalars):
+        want = want.add(p.scalar_mul(s))
+
+    # device: build affine limb points + digit rows
+    import jax.numpy as jnp
+
+    def to_limb_point(p):
+        zinv = pow(p.Z, em.P - 2, em.P)
+        x, y = p.X * zinv % em.P, p.Y * zinv % em.P
+        return (
+            F.int_to_limbs(x),
+            F.int_to_limbs(y),
+            F.int_to_limbs(1),
+            F.int_to_limbs(x * y % em.P),
+        )
+
+    comps = list(zip(*(to_limb_point(p) for p in pts_int)))
+    points = curve.Point(*(jnp.asarray(np.stack(c)) for c in comps))
+    sc_bytes = np.stack(
+        [
+            np.frombuffer(s.to_bytes(32, "little"), np.uint8).astype(np.int32)
+            for s in scalars
+        ]
+    )
+    digit_rows = jnp.asarray(np.ascontiguousarray(sc_bytes.T))
+    got = msm.msm(points, digit_rows)
+    gx, gy, gz = (
+        F.limbs_to_int(np.asarray(c)) for c in (got.x, got.y, got.z)
+    )
+    zinv = pow(gz, em.P - 2, em.P)
+    wzinv = pow(want.Z, em.P - 2, em.P)
+    assert gx * zinv % em.P == want.X * wzinv % em.P
+    assert gy * zinv % em.P == want.Y * wzinv % em.P
+
+
+def test_verify_batch_eq_happy_and_fallback():
+    from tendermint_tpu.crypto.tpu.verify import verify_batch_eq
+
+    items = _signed_items(20)
+    out = verify_batch_eq(items)
+    assert out.all() and len(out) == 20
+
+    bad = list(items)
+    p, m, s = bad[11]
+    bad[11] = (p, m, s[:20] + bytes([s[20] ^ 1]) + s[21:])
+    out = verify_batch_eq(bad)
+    assert not out[11] and out.sum() == 19
+
+
+def test_verify_batch_eq_malformed_entries():
+    from tendermint_tpu.crypto.tpu.verify import L as ELL, verify_batch_eq
+
+    items = _signed_items(8)
+    items[2] = (items[2][0], items[2][1], items[2][2][:32] + (ELL + 9).to_bytes(32, "little"))
+    items[5] = (b"\x01" * 31, items[5][1], items[5][2])  # short pubkey
+    out = verify_batch_eq(items)
+    assert not out[2] and not out[5] and out.sum() == 6
+
+
+def test_verify_resolved_sr25519():
+    """sr25519 signatures route through the same MSM kernel."""
+    from tendermint_tpu.crypto import sr25519 as sr
+    from tendermint_tpu.crypto.tpu.verify import resolve_sr25519, verify_resolved
+
+    entries = []
+    for i in range(6):
+        priv = sr.Sr25519PrivKey(bytes([0x30 + i]) * 32)
+        msg = b"sr-batch-%d" % i
+        sig = priv.sign(msg)
+        entries.append(resolve_sr25519(priv.pub_key().bytes(), msg, sig))
+    out = verify_resolved(entries)
+    assert out.all()
+
+    # tamper one -> per-sig fallback pinpoints it
+    priv = sr.Sr25519PrivKey(b"\x55" * 32)
+    sig = bytearray(priv.sign(b"x"))
+    sig[3] ^= 1
+    entries[4] = resolve_sr25519(priv.pub_key().bytes(), b"x", bytes(sig))
+    out = verify_resolved(entries)
+    assert not out[4] and out.sum() == 5
