@@ -176,8 +176,109 @@ async def _run_node(home: str) -> None:
 
 
 def cmd_start(args) -> int:
-    asyncio.run(_run_node(_home(args)))
+    from .libs.debug import install_debug_handlers
+
+    home = _home(args)
+    install_debug_handlers(home)  # pidfile + SIGUSR1 stack dumps
+    try:
+        asyncio.run(_run_node(home))
+    finally:
+        # a stale pidfile would let `debug kill` signal a recycled PID
+        try:
+            os.remove(os.path.join(home, "node.pid"))
+        except OSError:
+            pass
     return 0
+
+
+def cmd_replay(args) -> int:
+    """Replay the stored chain through a FRESH app instance and check the
+    app-hash chain (reference commands/replay.go console replay; here the
+    handshake machinery does the replay and the stores are the truth)."""
+
+    async def run() -> int:
+        from .abci.kvstore import KVStoreApp
+        from .consensus.replay import Handshaker
+        from .proxy import AppConns
+        from .state.state import state_from_genesis
+        from .state.store import StateStore
+        from .store.blockstore import BlockStore
+        from .store.db import MemDB, SQLiteDB
+        from .types.genesis import GenesisDoc
+
+        p = _paths(_home(args))
+        with open(p["genesis"]) as f:
+            genesis = GenesisDoc.from_json(f.read())
+        block_store = BlockStore(SQLiteDB(os.path.join(p["data"], "blockstore.db")))
+        state_store = StateStore(SQLiteDB(os.path.join(p["data"], "state.db")))
+        state = state_store.load() or state_from_genesis(genesis)
+        # a fresh in-memory app: the whole chain re-executes from genesis
+        conns = AppConns.local(KVStoreApp(MemDB()))
+        await conns.start()
+        try:
+            from .abci.types import RequestInfo
+
+            hs = Handshaker(state_store, state, block_store, genesis)
+            final = await hs.handshake(conns)
+            info = await conns.query.info(RequestInfo())
+            print(
+                json.dumps(
+                    {
+                        "replayed_to": final.last_block_height,
+                        "app_height": info.last_block_height,
+                        "app_hash": info.last_block_app_hash.hex(),
+                        "state_app_hash": final.app_hash.hex(),
+                    }
+                )
+            )
+            return 0
+        finally:
+            await conns.stop()
+
+    return asyncio.run(run())
+
+
+def cmd_debug(args) -> int:
+    """Collect diagnostics from a live node (reference
+    cmd/tendermint/commands/debug/{dump,kill}.go)."""
+    from .libs.debug import collect_node_state, write_dump_bundle
+
+    async def run() -> int:
+        from .rpc.client import HTTPClient
+
+        client = HTTPClient(args.address)
+        home = _home(args)
+        try:
+            if args.what == "dump":
+                os.makedirs(args.output_dir, exist_ok=True)
+                for i in range(args.count):
+                    snap = await collect_node_state(client)
+                    bundle = write_dump_bundle(args.output_dir, snap, home)
+                    print(f"wrote {bundle}")
+                    if i + 1 < args.count:
+                        await asyncio.sleep(args.interval)
+                return 0
+            # kill: snapshot, request a stack dump (SIGUSR1), then
+            # terminate via the pidfile
+            import signal as _sig
+
+            os.makedirs(args.output_dir, exist_ok=True)
+            snap = await collect_node_state(client)
+            write_dump_bundle(args.output_dir, snap, home)
+            with open(os.path.join(home, "node.pid")) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, _sig.SIGUSR1)  # goroutine-dump analog
+            await asyncio.sleep(1.0)
+            # fresh post-signal snapshot — the state being debugged
+            snap = await collect_node_state(client)
+            bundle = write_dump_bundle(args.output_dir, snap, home)
+            os.kill(pid, _sig.SIGTERM)
+            print(f"node {pid} terminated; diagnostics in {bundle}")
+            return 0
+        finally:
+            await client.close()
+
+    return asyncio.run(run())
 
 
 def cmd_testnet(args) -> int:
@@ -414,6 +515,19 @@ def main(argv: list[str] | None = None) -> int:
         help="run the verifying RPC proxy on this host:port instead of a one-shot verify",
     )
     p_light.set_defaults(fn=cmd_light)
+
+    p_replay = sub.add_parser(
+        "replay", help="re-execute the stored chain through a fresh app"
+    )
+    p_replay.set_defaults(fn=cmd_replay)
+
+    p_debug = sub.add_parser("debug", help="collect diagnostics from a live node")
+    p_debug.add_argument("what", choices=["dump", "kill"])
+    p_debug.add_argument("--address", default="http://127.0.0.1:26657")
+    p_debug.add_argument("--output-dir", default="./debug-dump")
+    p_debug.add_argument("--count", type=int, default=1)
+    p_debug.add_argument("--interval", type=float, default=5.0)
+    p_debug.set_defaults(fn=cmd_debug)
 
     args = parser.parse_args(argv)
     return args.fn(args)

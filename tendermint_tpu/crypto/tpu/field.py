@@ -100,10 +100,26 @@ for _i in range(LIMBS):
         _S_CONV[_i * LIMBS + _j, _i + _j] = 1.0
 
 
+# When True, `mul` routes to the Pallas VMEM-resident convolution kernel
+# (pallas_field.py) instead of the portable GEMM formulation. Enabled by
+# the verify module after probing that Pallas actually runs on the active
+# backend; must be set BEFORE kernels are traced.
+_USE_PALLAS = False
+
+
+def set_pallas(on: bool) -> None:
+    global _USE_PALLAS
+    _USE_PALLAS = bool(on)
+
+
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field multiply. Inputs: limbs < 2^9 (the module invariant).
     Output: limbs ≤ 293 (< 2^9). See module docstring for the exactness
     and carry-bound analysis."""
+    if _USE_PALLAS:
+        from . import pallas_field
+
+        return pallas_field.mul(a, b)
     a, b = jnp.broadcast_arrays(a, b)
     af = a.astype(jnp.float32)
     bf = b.astype(jnp.float32)

@@ -142,7 +142,39 @@ def _ensure_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass  # cache is an optimization, never a requirement
+    _maybe_enable_pallas()
     _cache_ready = True
+
+
+def _maybe_enable_pallas() -> None:
+    """Route field multiplies through the Pallas VMEM kernel when the
+    backend can actually run it (probed with one tiny multiply, checked
+    against the GEMM path). TMTPU_NO_PALLAS=1 pins the portable path."""
+    if os.environ.get("TMTPU_NO_PALLAS"):
+        return
+    import jax
+
+    from . import field as F
+
+    try:
+        if jax.default_backend() != "tpu":
+            return
+        from . import pallas_field
+
+        a = np.full((4, 32), 3, np.int32)
+        want = np.asarray(F.mul(a, a))
+        got = np.asarray(pallas_field.mul(a, a))
+        if not all(
+            F.limbs_to_int(want[i]) == F.limbs_to_int(got[i]) for i in range(4)
+        ):
+            raise RuntimeError("pallas field mul mismatch")
+        F.set_pallas(True)
+    except Exception as e:  # noqa: BLE001 — GEMM path keeps working
+        import logging
+
+        logging.getLogger("crypto.tpu").info(
+            "pallas field kernel unavailable (%r); using GEMM path", e
+        )
 
 
 def _get_kernel():

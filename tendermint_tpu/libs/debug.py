@@ -1,0 +1,119 @@
+"""Live-node debugging hooks (the analog of the reference's pprof server,
+config/config.go:529, and `tendermint debug` collection,
+cmd/tendermint/commands/debug/).
+
+Go exposes goroutine/heap profiles over HTTP; the Python equivalent here:
+
+  install_debug_handlers(home) — called by `start`:
+    * faulthandler on SIGSEGV/SIGABRT (hard-crash tracebacks),
+    * SIGUSR1 → dump every thread's Python stack AND every asyncio task
+      to <home>/debug/stacks-<ts>.txt (the goroutine-dump analog),
+    * a pidfile at <home>/node.pid so `debug kill` can target the node.
+
+  collect_node_state(...) — snapshot a live node over RPC (status,
+  consensus state, net info, unconfirmed txs) for `debug dump` bundles.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import io
+import json
+import os
+import signal
+import sys
+import time
+
+
+def _dump_asyncio_tasks(buf: io.StringIO) -> None:
+    import asyncio
+
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        # handler fires on the main thread; find any running loop via the
+        # task registry instead
+        loop = None
+    tasks = asyncio.all_tasks(loop) if loop else []
+    buf.write(f"\n=== asyncio tasks ({len(tasks)}) ===\n")
+    for t in tasks:
+        buf.write(f"-- {t.get_name()}: {t!r}\n")
+        stack = t.get_stack(limit=8)
+        for frame in stack:
+            buf.write(
+                f"   {frame.f_code.co_filename}:{frame.f_lineno} "
+                f"{frame.f_code.co_name}\n"
+            )
+
+
+def install_debug_handlers(home: str) -> None:
+    debug_dir = os.path.join(home, "debug")
+    os.makedirs(debug_dir, exist_ok=True)
+    with open(os.path.join(home, "node.pid"), "w") as f:
+        f.write(str(os.getpid()))
+    faulthandler.enable()
+
+    def on_sigusr1(_sig, _frame) -> None:
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        path = os.path.join(debug_dir, f"stacks-{ts}.txt")
+        with open(path, "w") as f:
+            f.write(f"=== thread stacks pid={os.getpid()} ===\n")
+            f.flush()
+            # faulthandler writes via the fd, not the Python file object
+            faulthandler.dump_traceback(file=f)
+            buf = io.StringIO()
+            try:
+                _dump_asyncio_tasks(buf)
+            except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+                buf.write(f"(task dump failed: {e!r})\n")
+            f.write(buf.getvalue())
+        print(f"debug: stacks dumped to {path}", file=sys.stderr)
+
+    signal.signal(signal.SIGUSR1, on_sigusr1)
+
+
+async def collect_node_state(rpc_client) -> dict:
+    """Snapshot a live node over RPC (reference debug/dump.go shape)."""
+    out: dict = {"collected_at": time.time()}
+    for name, method in (
+        ("status", "status"),
+        ("consensus_state", "consensus_state"),
+        ("net_info", "net_info"),
+        ("num_unconfirmed_txs", "num_unconfirmed_txs"),
+    ):
+        try:
+            out[name] = await rpc_client.call(method)
+        except Exception as e:  # noqa: BLE001
+            out[name] = {"error": repr(e)}
+    return out
+
+
+def write_dump_bundle(dest_dir: str, snapshot: dict, home: str | None) -> str:
+    """Write one timestamped dump bundle: the RPC snapshot plus local
+    artifacts (config, recent stack dumps) when `home` is given. Bundle
+    names carry a monotonic suffix so rapid dumps never merge."""
+    ts = time.strftime("%Y%m%d-%H%M%S")
+    n = 0
+    while True:
+        bundle = os.path.join(dest_dir, f"dump-{ts}-{n}")
+        if not os.path.exists(bundle):
+            break
+        n += 1
+    os.makedirs(bundle)
+    with open(os.path.join(bundle, "node_state.json"), "w") as f:
+        json.dump(snapshot, f, indent=2, default=repr)
+    if home:
+        cfg = os.path.join(home, "config", "config.toml")
+        if os.path.exists(cfg):
+            import shutil
+
+            shutil.copy(cfg, os.path.join(bundle, "config.toml"))
+        debug_dir = os.path.join(home, "debug")
+        if os.path.isdir(debug_dir):
+            import shutil
+
+            for name in sorted(os.listdir(debug_dir))[-3:]:
+                shutil.copy(
+                    os.path.join(debug_dir, name), os.path.join(bundle, name)
+                )
+    return bundle
