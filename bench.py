@@ -224,13 +224,12 @@ async def _bench_blocksync_async(n_blocks: int, n_vals: int, window: int) -> flo
                 h, state, commit, state.validators.get_proposer().address
             )
             bid = block.block_id(parts.header)
-            bstore.save_block(block, parts, None)
             state, _ = await ex.apply_block(state, bid, block)
             commit = tt.make_commit(
                 "bs-bench", h, 0, bid, state.last_validators, by_addr,
                 timestamp_ns=block.header.time_ns + 1,
             )
-            bstore.save_seen_commit(h, commit)
+            bstore.save_block(block, parts, commit)
         log(f"blocksync: built {n_blocks}-block chain in {_t.perf_counter()-t0:.1f}s")
         return bstore, conns
 

@@ -183,9 +183,13 @@ def cmd_start(args) -> int:
     try:
         asyncio.run(_run_node(home))
     finally:
-        # a stale pidfile would let `debug kill` signal a recycled PID
+        # a stale pidfile would let `debug kill` signal a recycled PID;
+        # remove only OUR pidfile (never another live node's)
+        pid_path = os.path.join(home, "node.pid")
         try:
-            os.remove(os.path.join(home, "node.pid"))
+            with open(pid_path) as f:
+                if f.read().strip() == str(os.getpid()):
+                    os.remove(pid_path)
         except OSError:
             pass
     return 0
@@ -211,7 +215,12 @@ def cmd_replay(args) -> int:
             genesis = GenesisDoc.from_json(f.read())
         block_store = BlockStore(SQLiteDB(os.path.join(p["data"], "blockstore.db")))
         state_store = StateStore(SQLiteDB(os.path.join(p["data"], "state.db")))
-        state = state_store.load() or state_from_genesis(genesis)
+        stored = state_store.load()
+        # re-execute from GENESIS state (height 0): the handshaker's
+        # InitChain branch only fires when both app and state are fresh,
+        # so starting from the stored (advanced) state would skip app
+        # initialization (app_state seeding) and diverge immediately
+        state = state_from_genesis(genesis)
         # a fresh in-memory app: the whole chain re-executes from genesis
         conns = AppConns.local(KVStoreApp(MemDB()))
         await conns.start()
@@ -220,6 +229,12 @@ def cmd_replay(args) -> int:
 
             hs = Handshaker(state_store, state, block_store, genesis)
             final = await hs.handshake(conns)
+            if stored is not None and final.app_hash != stored.app_hash:
+                print(
+                    f"WARNING: replayed app hash {final.app_hash.hex()} != "
+                    f"stored {stored.app_hash.hex()}",
+                    file=sys.stderr,
+                )
             info = await conns.query.info(RequestInfo())
             print(
                 json.dumps(

@@ -31,10 +31,14 @@ def _dump_asyncio_tasks(buf: io.StringIO) -> None:
     try:
         loop = asyncio.get_running_loop()
     except RuntimeError:
-        # handler fires on the main thread; find any running loop via the
-        # task registry instead
-        loop = None
-    tasks = asyncio.all_tasks(loop) if loop else []
+        # signal handlers run on the main thread; if the loop lives on a
+        # different thread (embedders) its tasks can't be enumerated from
+        # here — say so rather than writing a misleading empty list
+        buf.write(
+            "\n=== asyncio tasks: no running loop on the signal thread ===\n"
+        )
+        return
+    tasks = asyncio.all_tasks(loop)
     buf.write(f"\n=== asyncio tasks ({len(tasks)}) ===\n")
     for t in tasks:
         buf.write(f"-- {t.get_name()}: {t!r}\n")
@@ -49,7 +53,23 @@ def _dump_asyncio_tasks(buf: io.StringIO) -> None:
 def install_debug_handlers(home: str) -> None:
     debug_dir = os.path.join(home, "debug")
     os.makedirs(debug_dir, exist_ok=True)
-    with open(os.path.join(home, "node.pid"), "w") as f:
+    pid_path = os.path.join(home, "node.pid")
+    if os.path.exists(pid_path):
+        # refuse to clobber a LIVE node's pidfile (a second accidental
+        # `start` would otherwise point `debug kill` at the wrong pid —
+        # or delete the file on its way out)
+        try:
+            with open(pid_path) as f:
+                old_pid = int(f.read().strip())
+            os.kill(old_pid, 0)
+        except (OSError, ValueError):
+            pass  # stale or unreadable: take it over
+        else:
+            raise RuntimeError(
+                f"node already running in {home} (pid {old_pid}); "
+                "remove node.pid if this is stale"
+            )
+    with open(pid_path, "w") as f:
         f.write(str(os.getpid()))
     faulthandler.enable()
 

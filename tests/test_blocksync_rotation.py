@@ -1,0 +1,157 @@
+"""Blocksync range verification across a mid-window validator rotation
+(the correctness backstop of the range-batching design —
+blocksync/reactor.py stale-set guard + sequential fallback; the reference
+verifies one block at a time so this failure mode cannot exist there).
+
+A chain is built whose validator set CHANGES at a rotation height via a
+kvstore `val:` tx; a fresh node block-syncs it through the real reactor
+with a window spanning the rotation, so the batched verify (pinned to the
+pre-rotation set) fails mid-range and the reactor must recover via its
+per-block re-verify / sequential fallback — applying every block without
+punishing any peer."""
+
+import asyncio
+
+import pytest
+
+from tendermint_tpu import testing as tt
+from tendermint_tpu.abci.kvstore import KVStoreApp
+from tendermint_tpu.blocksync import BLOCKSYNC_CHANNEL
+from tendermint_tpu.blocksync import messages as bsm
+from tendermint_tpu.blocksync.reactor import BlockSyncReactor
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.mempool.pool import PriorityMempool
+from tendermint_tpu.p2p.peermanager import PeerStatus, PeerUpdate
+from tendermint_tpu.p2p.router import Channel
+from tendermint_tpu.p2p.types import Envelope
+from tendermint_tpu.proxy import AppConns
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import state_from_genesis
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.blockstore import BlockStore
+from tendermint_tpu.store.db import MemDB
+from tendermint_tpu.testing import det_priv_keys
+from tendermint_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+CHAIN = "rotation-chain"
+N_BLOCKS = 24
+ROTATE_AT = 10  # join height of the new validator (inside the window)
+
+
+def _genesis(keys):
+    return GenesisDoc(
+        chain_id=CHAIN,
+        initial_height=1,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=[
+            GenesisValidator(k.pub_key(), 10, f"v{i}") for i, k in enumerate(keys)
+        ],
+    )
+
+
+async def _build_rotating_chain(genesis, all_keys, new_key):
+    """Chain where `new_key` joins the validator set via a val: tx
+    committed at ROTATE_AT (effective two heights later)."""
+    by_addr = {k.pub_key().address(): k for k in all_keys}
+    app = KVStoreApp()
+    conns = AppConns.local(app)
+    await conns.start()
+    bstore, sstore = BlockStore(MemDB()), StateStore(MemDB())
+    state = await Handshaker(
+        sstore, state_from_genesis(genesis), bstore, genesis
+    ).handshake(conns)
+    sstore.save(state)
+    mempool = PriorityMempool(MempoolConfig(), conns.mempool, height=0)
+    ex = BlockExecutor(sstore, conns.consensus, mempool=mempool, block_store=bstore)
+    commit = None
+    rotated = False
+    for h in range(1, N_BLOCKS + 1):
+        if h == ROTATE_AT:
+            await mempool.check_tx(
+                b"val:" + new_key.pub_key().bytes().hex().encode() + b"!10"
+            )
+        block, parts = ex.create_proposal_block(
+            h, state, commit, state.validators.get_proposer().address
+        )
+        bid = block.block_id(parts.header)
+        state, _ = await ex.apply_block(state, bid, block)
+        if len(state.validators) > len(genesis.validators):
+            rotated = True
+        commit = tt.make_commit(
+            CHAIN, h, 0, bid, state.last_validators, by_addr,
+            timestamp_ns=block.header.time_ns + 1,
+        )
+        bstore.save_block(block, parts, commit)
+    assert rotated, "validator set never rotated — test is vacuous"
+    await conns.stop()
+    return bstore
+
+
+@pytest.mark.asyncio
+async def test_range_sync_through_validator_rotation():
+    keys = det_priv_keys(3)
+    new_key = det_priv_keys(1, seed=b"joiner")[0]
+    genesis = _genesis(keys)
+    src_store = await _build_rotating_chain(genesis, keys + [new_key], new_key)
+
+    # target: fresh node, real reactor, window spanning the rotation
+    app = KVStoreApp()
+    conns = AppConns.local(app)
+    await conns.start()
+    bstore, sstore = BlockStore(MemDB()), StateStore(MemDB())
+    state = await Handshaker(
+        sstore, state_from_genesis(genesis), bstore, genesis
+    ).handshake(conns)
+    sstore.save(state)
+    ex = BlockExecutor(sstore, conns.consensus, block_store=bstore)
+    ch = Channel(BLOCKSYNC_CHANNEL, "bs", 5, bsm.encode_message, bsm.decode_message)
+    peer_q: asyncio.Queue = asyncio.Queue()
+    reactor = BlockSyncReactor(
+        state, ex, bstore, ch, peer_q, window=N_BLOCKS, active=True
+    )
+    punished = []
+
+    async def serve():
+        while True:
+            env = await ch.out_q.get()
+            msg = env.message
+            if isinstance(msg, bsm.StatusRequest):
+                await ch.in_q.put(
+                    Envelope(
+                        BLOCKSYNC_CHANNEL,
+                        bsm.StatusResponse(src_store.height(), src_store.base()),
+                        from_="peer0",
+                    )
+                )
+            elif isinstance(msg, bsm.BlockRequest):
+                blk = src_store.load_block(msg.height)
+                if blk is not None:
+                    await ch.in_q.put(
+                        Envelope(BLOCKSYNC_CHANNEL, bsm.BlockResponse(blk), from_="peer0")
+                    )
+
+    async def watch_errors():
+        while True:
+            punished.append(await ch.err_q.get())
+
+    server = asyncio.get_running_loop().create_task(serve())
+    watcher = asyncio.get_running_loop().create_task(watch_errors())
+    await peer_q.put(PeerUpdate("peer0", PeerStatus.UP))
+    await reactor.start()
+    try:
+        await asyncio.wait_for(reactor.synced.wait(), timeout=120)
+    finally:
+        server.cancel()
+        watcher.cancel()
+        await reactor.stop()
+        await conns.stop()
+
+    # the whole chain applied, through the rotation
+    assert bstore.height() >= N_BLOCKS - 1
+    # the new validator is in the synced node's set
+    final_vals = sstore.load_validators(bstore.height())
+    assert final_vals is not None and len(final_vals) == 4
+    # an honest rotation must punish nobody
+    assert punished == [], [str(p) for p in punished]
+    assert reactor.metrics["blocks_applied"] >= N_BLOCKS - 1
