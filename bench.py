@@ -38,25 +38,41 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def reexec_forced_cpu(reason: str) -> None:
-    """Replace this process with a forced-CPU rerun of the benchmark.
-    Used when a thread is wedged inside backend init or a device call —
-    that thread holds jax's global backend lock, so no in-process fallback
-    can make progress."""
-    log(f"{reason}; re-execing with forced CPU for the fallback run")
+def _reexec(env_updates: dict, reason: str) -> None:
+    """Replace this process with a fresh run of the benchmark. A hung
+    thread inside xla_bridge.backends() holds jax's global backend lock,
+    so no jax call in this process can ever complete — the ONLY safe
+    recovery is a fresh interpreter."""
+    log(f"{reason}; re-execing ({env_updates})")
     sys.stderr.flush()
     sys.stdout.flush()
-    env = dict(os.environ, JAX_PLATFORMS="cpu", TMTPU_BENCH_FORCED_CPU="1")
+    env = dict(os.environ, **env_updates)
     os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
 
 
-def init_backend(attempts: int = 3, timeout_s: float = 180.0) -> str:
+def reexec_forced_cpu(reason: str) -> None:
+    _reexec({"JAX_PLATFORMS": "cpu", "TMTPU_BENCH_FORCED_CPU": "1"}, reason)
+
+
+def reexec_fresh_tpu(reason: str, counter_var: str, max_tries: int) -> None:
+    """Retry the TPU backend in a FRESH process before giving up on the
+    chip (round-4 postmortem: one transient tunnel wedge cost the round
+    its only TPU datapoint because the first hang went straight to the
+    CPU re-exec). counter_var tracks re-exec attempts across execs;
+    when exhausted, fall through to the forced-CPU run."""
+    n = int(os.environ.get(counter_var, "0"))
+    if n + 1 >= max_tries:
+        reexec_forced_cpu(f"{reason} (fresh-TPU retries exhausted: {n + 1}/{max_tries})")
+    time.sleep(10.0)  # give a flapping tunnel a beat before reconnecting
+    _reexec({counter_var: str(n + 1)}, f"{reason} (fresh-TPU retry {n + 1}/{max_tries})")
+
+
+def init_backend(attempts: int = 3, timeout_s: float = 120.0) -> str:
     """Initialize a JAX backend, preferring the ambient platform (the TPU
     tunnel), with a watchdog thread per attempt. Failed (raised) inits are
-    retried, then fall back to the CPU backend in-process. A HUNG init is
-    different: the stuck thread holds jax's global backend lock, so no jax
-    call in this process can ever complete — the only safe fallback is to
-    re-exec the benchmark with JAX_PLATFORMS=cpu. Returns the platform."""
+    retried in-process; a HUNG init re-execs into a fresh TPU attempt
+    (fresh xla_bridge state) up to 3 total tries, and only then re-execs
+    with JAX_PLATFORMS=cpu. Returns the platform."""
     import jax
 
     if os.environ.get("TMTPU_BENCH_FORCED_CPU") == "1":
@@ -85,10 +101,11 @@ def init_backend(attempts: int = 3, timeout_s: float = 180.0) -> str:
             log(f"backend up after {time.time()-t0:.1f}s: {result['devices']}")
             return platform
         if t.is_alive():
-            # init is wedged inside xla_bridge.backends(), which holds
-            # _backend_lock for the whole call — every other jax call in
-            # this process (including a CPU fallback) would block on it.
-            reexec_forced_cpu(f"backend init hung past {timeout_s:.0f}s")
+            reexec_fresh_tpu(
+                f"backend init hung past {timeout_s:.0f}s",
+                "TMTPU_BENCH_INIT_RETRY",
+                max_tries=3,
+            )
         log(f"backend init attempt {i+1}/{attempts} failed: "
             f"{result.get('error')!r}")
         if i < attempts - 1:
@@ -114,6 +131,126 @@ def _build_commit_items(n_vals, n_commits, chain_id="bench-chain"):
                 (val.pub_key.bytes(), commit.vote_sign_bytes(chain_id, idx), cs.signature)
             )
     return vals, keys, commits, items
+
+
+def kernel_breakdown(items: list) -> dict:
+    """Stage-level timing of the batch-equation kernel on the live backend
+    (VERDICT r4 #1: decompress vs window scans vs Horner fold, plus a
+    field-mul count and achieved-FLOP estimate). Each stage is jitted
+    separately on the SAME padded batch; the deltas attribute the
+    end-to-end time. Diagnostics only — production uses the fused kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from tendermint_tpu.crypto.tpu import curve, msm
+    from tendermint_tpu.crypto.tpu import verify as tpuv
+    from tendermint_tpu.crypto.tpu.curve import Point
+
+    # cap the stage-timing batch: the sub-stages are separate XLA
+    # compiles, and 1024 is representative without risking the driver's
+    # time budget on compile
+    entries = [tpuv.resolve_ed25519(*it) for it in items[:1024]]
+    b = tpuv._bucket(len(entries))
+    a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid = tpuv.prepare_batch_eq(
+        entries, pad_to=b
+    )
+
+    def timeit(fn, *args, reps=5):
+        out = fn(*args)
+        jax.block_until_ready(out)  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / reps
+
+    dec = jax.jit(
+        lambda ab, rb: curve.decompress(jnp.concatenate([ab, rb], axis=0))
+    )
+    t_dec = timeit(dec, a_bytes, r_bytes)
+    stacked, _ok = dec(a_bytes, r_bytes)
+    pts = Point(*(jnp.asarray(c[:b]) for c in stacked))
+
+    msm_fn = jax.jit(msm.msm)
+    t_msm_a = timeit(msm_fn, pts, jnp.asarray(a_digits[:, :b]))  # 32 windows
+    t_msm_r = timeit(msm_fn, pts, jnp.asarray(r_digits[:, :b]))  # 16 windows
+    t_full = timeit(
+        jax.jit(tpuv._kernel_eq),
+        a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid,
+    )
+
+    # arithmetic accounting: point_add ≈ 9 field muls, double ≈ 8.
+    # Per window: sort + associative_scan (~2M adds) + 256-leaf collapse
+    # (~264 adds) + 255× multiply (7 dbl + 7 add). 48 windows total
+    # (32 A-group + 16 R-group); Horner fold adds 8 dbl + 1 add per window.
+    n_windows = 48
+    adds_per_window = 2 * b + 264 + 14
+    fmuls = n_windows * (adds_per_window * 9 + 8 * 8 + 9)
+    # one field mul (GEMM path) routes 32*32*32 ≈ 32.8k f32 MACs through
+    # the MXU per element-pair after batching
+    flops = fmuls * 2 * 32 * 32 * 32
+    bd = {
+        "batch": b,
+        "decompress_ms": round(t_dec * 1e3, 2),
+        "msm_a32_ms": round(t_msm_a * 1e3, 2),
+        "msm_r16_ms": round(t_msm_r * 1e3, 2),
+        "fused_total_ms": round(t_full * 1e3, 2),
+        "field_muls_est": fmuls,
+        "achieved_tflops_est": round(flops / t_full / 1e12, 3),
+    }
+    log(f"kernel breakdown: {bd}")
+    if tpuv.field_mul_probe:
+        bd["field_mul_probe"] = dict(tpuv.field_mul_probe)
+        log(f"field-mul A/B probe: {tpuv.field_mul_probe}")
+    return bd
+
+
+def bench_mixed_commit(n_vals: int, n_commits: int) -> float:
+    """BASELINE config 4: mixed ed25519 + secp256k1 validator set through
+    verify_commit_light (reference types/validator_set.go VerifyCommitLight
+    with a heterogeneous key set). Returns sigs/sec."""
+    from tendermint_tpu import testing as tt
+    from tendermint_tpu.types import validation
+
+    chain_id = "mixed-bench"
+    vals, keys = tt.make_validator_set(
+        n_vals, power=10, key_types=("ed25519", "secp256k1")
+    )
+    pairs = []
+    for h in range(1, n_commits + 1):
+        bid = tt.make_block_id(b"mixed-%d" % h)
+        pairs.append((bid, tt.make_commit(chain_id, h, 0, bid, vals, keys)))
+    t0 = time.perf_counter()
+    total = 0
+    for bid, commit in pairs:
+        validation.verify_commit_light(chain_id, vals, bid, commit.height, commit)
+        total += sum(1 for cs in commit.signatures if cs.is_commit())
+    dt = time.perf_counter() - t0
+    rate = total / dt
+    log(
+        f"mixed-key commit: {total} sigs over {n_commits} commits in {dt:.2f}s "
+        f"-> {rate:,.1f} sigs/s"
+    )
+    return rate
+
+
+def bench_statesync(n_blocks: int, n_vals: int) -> float:
+    """BASELINE config 5: statesync snapshot restore + backfill commit
+    verification (reference internal/statesync/reactor.go:348-369 shape,
+    in-process). Returns backfilled+verified blocks/sec."""
+    import asyncio
+
+    from tendermint_tpu.testing import statesync_restore_scenario
+
+    t0 = time.perf_counter()
+    n_verified = asyncio.run(statesync_restore_scenario(n_blocks, n_vals))
+    dt = time.perf_counter() - t0
+    rate = n_verified / dt
+    log(
+        f"statesync: restored + backfilled {n_verified} blocks in {dt:.2f}s "
+        f"-> {rate:,.1f} blocks/s"
+    )
+    return rate
 
 
 def bench_light_client(n_headers: int, n_vals: int) -> float:
@@ -385,7 +522,13 @@ def main() -> None:
     if "bitmap" not in wres:
         if os.environ.get("TMTPU_BENCH_FORCED_CPU") == "1" or backend == "cpu":
             raise RuntimeError(f"warmup failed on CPU backend: {wres.get('error')!r}")
-        reexec_forced_cpu(f"warmup hung/failed on {backend} ({wres.get('error')!r})")
+        # a tunnel that came up for init can still wedge on the first
+        # compile/execute: worth one fresh-process TPU retry before CPU
+        reexec_fresh_tpu(
+            f"warmup hung/failed on {backend} ({wres.get('error')!r})",
+            "TMTPU_BENCH_WARMUP_RETRY",
+            max_tries=2,
+        )
     bitmap = wres["bitmap"]
     assert bool(np.all(bitmap)), "verification failed on valid commits"
     log(f"warmup+compile: {time.perf_counter()-t0:.1f}s")
@@ -408,12 +551,16 @@ def main() -> None:
     tpu_rate = len(items) / tpu_dt
     log(f"{backend} end-to-end: {tpu_rate:,.0f} sigs/s ({tpu_dt*1e3:.1f} ms / {len(items)})")
 
-    # -- secondary configs (BASELINE.md 2 and 3) --------------------------
+    # -- secondary configs (BASELINE.md 2-5) ------------------------------
     extra = {}
     if backend != "cpu":
         from tendermint_tpu.crypto import batch as crypto_batch
 
         crypto_batch.tpu_verifier_available(blocking=True)
+        try:
+            extra["kernel_breakdown"] = kernel_breakdown(items)
+        except Exception as e:  # noqa: BLE001
+            log(f"kernel breakdown failed: {e!r}")
         try:
             extra["light_headers_per_s"] = round(bench_light_client(1000, n_vals), 1)
         except Exception as e:  # noqa: BLE001
@@ -424,6 +571,14 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001
             log(f"blocksync bench failed: {e!r}")
+        try:
+            extra["mixed_commit_sigs_per_s"] = round(bench_mixed_commit(n_vals, 4), 1)
+        except Exception as e:  # noqa: BLE001
+            log(f"mixed-key bench failed: {e!r}")
+        try:
+            extra["statesync_blocks_per_s"] = round(bench_statesync(64, 21), 1)
+        except Exception as e:  # noqa: BLE001
+            log(f"statesync bench failed: {e!r}")
     else:
         log("secondary configs skipped on cpu fallback")
     extra["cpu_multicore_sigs_per_s"] = round(cpu_mt_rate, 1)

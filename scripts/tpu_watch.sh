@@ -1,0 +1,34 @@
+#!/bin/bash
+# Poll the axon TPU tunnel; when it answers, run the headline benchmark
+# once and record the JSON + diagnostics in the repo (TPU_RUN.json /
+# TPU_RUN.log). The analog of keeping a long-running perf canary pointed
+# at scarce hardware: the tunnel flaps, the watcher catches the window.
+#
+# Usage: scripts/tpu_watch.sh [max_attempts] [poll_seconds]
+set -u
+cd "$(dirname "$0")/.."
+MAX=${1:-600}
+POLL=${2:-45}
+LOG=${TMTPU_WATCH_LOG:-TPU_RUN.log}
+OUT=${TMTPU_WATCH_OUT:-TPU_RUN.json}
+for i in $(seq 1 "$MAX"); do
+  if timeout 90 python -u -c "
+import threading, sys
+import jax
+res={}
+def p():
+    try: res['d']=jax.devices()
+    except Exception as e: res['e']=e
+t=threading.Thread(target=p,daemon=True); t.start(); t.join(75)
+sys.exit(0 if 'd' in res else 1)
+" 2>/dev/null; then
+    echo "$(date +%H:%M:%S) tunnel up; running bench.py" >> "$LOG"
+    timeout 3000 python -u bench.py > "$OUT" 2>> "$LOG"
+    echo "$(date +%H:%M:%S) bench rc=$? -> $OUT" >> "$LOG"
+    exit 0
+  fi
+  echo "$(date +%H:%M:%S) tunnel down ($i/$MAX)" >> "$LOG"
+  sleep "$POLL"
+done
+echo "$(date +%H:%M:%S) gave up after $MAX attempts" >> "$LOG"
+exit 1

@@ -1,0 +1,150 @@
+"""gRPC ABCI transport (reference abci/client/grpc_client.go:1 and
+abci/server/grpc_server.go:1) — the second first-class way to attach an
+out-of-process app.
+
+Uses grpc.aio with GENERIC method handlers: the method table and the
+dataclass codec are shared with the socket transport (socket.py), so the
+two attachment modes cannot drift apart. No protoc codegen — the payload
+codec is the framework's own deterministic dataclass JSON (the reference
+generates stubs from abci/types.proto; here the registry in socket.py is
+the schema).
+
+Unlike the socket transport (strict pipelining on one connection), gRPC
+multiplexes; app access is serialized server-side with one lock, which is
+the same guarantee the reference's grpc server gives via the app mutex.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+import grpc
+
+from .application import Application
+from .client import Client
+from .socket import _METHODS, _from_jsonable, _to_jsonable
+
+SERVICE = "tendermint.abci.ABCI"
+
+
+def _dumps(obj) -> bytes:
+    # envelope dict: grpc.aio silently coerces bare-str messages to bytes
+    # BEFORE the serializer runs, so payloads must never be naked strings
+    return json.dumps({"v": _to_jsonable(obj)}).encode()
+
+
+def _loads(data: bytes):
+    return _from_jsonable(json.loads(data)["v"]) if data else None
+
+
+class GrpcABCIServer:
+    """Serves a local Application over gRPC (reference
+    abci/server/grpc_server.go)."""
+
+    def __init__(self, app: Application, *, logger: logging.Logger | None = None):
+        self.app = app
+        self.logger = logger or logging.getLogger("abci.grpc")
+        self._server: grpc.aio.Server | None = None
+        self.port: int | None = None
+        self._lock = asyncio.Lock()
+
+    def _handler(self, method: str, has_req: bool):
+        async def handle(request, context):
+            if method == "echo":
+                # grpc.aio coerces bare-str RESPONSES to bytes before the
+                # serializer — wrap in a message dict (reference
+                # ResponseEcho{message}); the client unwraps
+                return {"message": request}
+            fn = getattr(self.app, method)
+            async with self._lock:
+                return fn(request) if has_req else fn()
+
+        return handle
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._server = grpc.aio.server()
+        handlers = {
+            method: grpc.unary_unary_rpc_method_handler(
+                self._handler(method, has_req),
+                request_deserializer=_loads,
+                response_serializer=_dumps,
+            )
+            for method, has_req in _METHODS.items()
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        await self._server.start()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+
+
+class GrpcClient(Client):
+    """ABCI client over gRPC (reference abci/client/grpc_client.go).
+    Concurrency is the channel's — no client-side pipelining needed."""
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._channel: grpc.aio.Channel | None = None
+        self._stubs: dict[str, object] = {}
+
+    async def start(self) -> None:
+        self._channel = grpc.aio.insecure_channel(f"{self.host}:{self.port}")
+        for method in _METHODS:
+            self._stubs[method] = self._channel.unary_unary(
+                f"/{SERVICE}/{method}",
+                request_serializer=_dumps,
+                response_deserializer=_loads,
+            )
+
+    async def stop(self) -> None:
+        if self._channel is not None:
+            await self._channel.close()
+
+    async def _call(self, method: str, req=None):
+        return await self._stubs[method](req)
+
+    async def echo(self, msg: str) -> str:
+        return (await self._call("echo", msg))["message"]
+
+    async def info(self, req):
+        return await self._call("info", req)
+
+    async def query(self, req):
+        return await self._call("query", req)
+
+    async def check_tx(self, req):
+        return await self._call("check_tx", req)
+
+    async def init_chain(self, req):
+        return await self._call("init_chain", req)
+
+    async def begin_block(self, req):
+        return await self._call("begin_block", req)
+
+    async def deliver_tx(self, req):
+        return await self._call("deliver_tx", req)
+
+    async def end_block(self, req):
+        return await self._call("end_block", req)
+
+    async def commit(self):
+        return await self._call("commit")
+
+    async def list_snapshots(self):
+        return await self._call("list_snapshots")
+
+    async def offer_snapshot(self, req):
+        return await self._call("offer_snapshot", req)
+
+    async def load_snapshot_chunk(self, req):
+        return await self._call("load_snapshot_chunk", req)
+
+    async def apply_snapshot_chunk(self, req):
+        return await self._call("apply_snapshot_chunk", req)

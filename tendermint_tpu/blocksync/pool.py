@@ -45,6 +45,10 @@ class BlockPool:
         self.blocks: dict[int, tuple[Block, str]] = {}  # height -> (block, provider)
         self.started_at = time.monotonic()
         self._last_advance = time.monotonic()
+        # when the peer set last BECAME empty — the zero-peer caught-up
+        # grace measures from here, not from pool start, so a transient
+        # total peer loss mid-sync doesn't instantly report caught-up
+        self._no_peers_since = time.monotonic()
 
     # -- peers -----------------------------------------------------------
 
@@ -57,6 +61,8 @@ class BlockPool:
         p = self.peers.pop(peer_id, None)
         if p is None:
             return []
+        if not self.peers:
+            self._no_peers_since = time.monotonic()
         redo = []
         for h in list(p.pending):
             self.requests.pop(h, None)
@@ -165,7 +171,15 @@ class BlockPool:
             self.remove_peer(pid)
 
     def is_caught_up(self) -> bool:
-        """Within one block of the best peer (reference IsCaughtUp)."""
-        if not self.peers:
-            return False
-        return self.height >= self.max_peer_height()
+        """Within one block of the best peer (reference pool.go IsCaughtUp):
+        caught up once we've waited a startup grace for peers to report AND
+        our chain is the longest we know of. No peer-count gate — a solo
+        validator (or an isolated node at the tip) must still hand over to
+        consensus after the grace period."""
+        if self.peers:
+            return self.height >= self.max_peer_height()
+        # nobody reported a height: give discovery a grace window (from
+        # the moment we LAST had no peers, not pool start), then hand
+        # over — consensus lag triggers a switch-back if a taller peer
+        # shows up later (reactor.resume)
+        return time.monotonic() - self._no_peers_since > 5.0

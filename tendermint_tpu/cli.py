@@ -116,8 +116,37 @@ def _build_node(home: str):
     )
     if cfg.proxy_app == "kvstore":
         app = KVStoreApp(SQLiteDB(os.path.join(p["data"], "app.db")))
+    elif cfg.proxy_app.startswith(("tcp://", "grpc://")):
+        # out-of-process app (reference config proxy_app semantics:
+        # tcp://host:port = socket ABCI, grpc://host:port = gRPC ABCI)
+        from .proxy import AppConns
+
+        scheme, addr = cfg.proxy_app.split("://", 1)
+        try:
+            host, port_s = addr.rsplit(":", 1)
+            int(port_s)
+        except ValueError:
+            raise SystemExit(
+                f"invalid proxy_app address {cfg.proxy_app!r} "
+                "(expected tcp://host:port or grpc://host:port)"
+            ) from None
+        if scheme == "tcp":
+            from .abci.socket import SocketClient
+
+            def factory(name: str):
+                return SocketClient(host, int(port_s))
+        else:
+            from .abci.grpcnet import GrpcClient
+
+            def factory(name: str):
+                return GrpcClient(host, int(port_s))
+
+        app = AppConns.from_factory(factory)
     else:
-        raise SystemExit(f"unknown proxy app {cfg.proxy_app!r} (builtin: kvstore)")
+        raise SystemExit(
+            f"unknown proxy app {cfg.proxy_app!r} "
+            "(builtin: kvstore; remote: tcp://host:port, grpc://host:port)"
+        )
     state_sync = None
     if cfg.statesync.enable and cfg.statesync.trust_hash:
         state_sync = SyncConfig(
@@ -133,6 +162,7 @@ def _build_node(home: str):
         moniker=cfg.moniker,
         wal_dir=os.path.join(p["data"], "cs.wal"),
         rpc_laddr=cfg.rpc.laddr if cfg.rpc.enable else "",
+        rpc_pprof=cfg.rpc.pprof,
         seed_mode=cfg.mode == "seed",
         addr_book_path=os.path.join(p["config"], "addrbook.json"),
     )
@@ -160,6 +190,10 @@ async def _run_node(home: str) -> None:
     await node.start()
     for peer in filter(None, cfg.p2p.persistent_peers.split(",")):
         node.peer_manager.add_address(NodeAddress.parse(peer.strip()), persistent=True)
+    # seeds: dial once for an address push (the seed disconnects after
+    # serving; discovered addresses land in the address book via PEX)
+    for seed in filter(None, cfg.p2p.seeds.split(",")):
+        node.peer_manager.add_address(NodeAddress.parse(seed.strip()))
     print(f"node {node.node_id} running; p2p on {transport.endpoint()}", flush=True)
     stop = asyncio.Event()
     import signal
@@ -214,22 +248,24 @@ def cmd_replay(args) -> int:
         with open(p["genesis"]) as f:
             genesis = GenesisDoc.from_json(f.read())
         block_store = BlockStore(SQLiteDB(os.path.join(p["data"], "blockstore.db")))
-        state_store = StateStore(SQLiteDB(os.path.join(p["data"], "state.db")))
-        stored = state_store.load()
-        # re-execute from GENESIS state (height 0): the handshaker's
-        # InitChain branch only fires when both app and state are fresh,
-        # so starting from the stored (advanced) state would skip app
-        # initialization (app_state seeding) and diverge immediately
+        stored = StateStore(
+            SQLiteDB(os.path.join(p["data"], "state.db"))
+        ).load()
+        # re-execute from GENESIS state (height 0) against a fresh
+        # in-memory app AND a scratch state store: the replay rebuilds the
+        # whole state chain from the block store without ever writing to
+        # the node's real state.db
         state = state_from_genesis(genesis)
-        # a fresh in-memory app: the whole chain re-executes from genesis
+        scratch = StateStore(MemDB())
         conns = AppConns.local(KVStoreApp(MemDB()))
         await conns.start()
         try:
             from .abci.types import RequestInfo
 
-            hs = Handshaker(state_store, state, block_store, genesis)
+            hs = Handshaker(scratch, state, block_store, genesis)
             final = await hs.handshake(conns)
-            if stored is not None and final.app_hash != stored.app_hash:
+            mismatch = stored is not None and final.app_hash != stored.app_hash
+            if mismatch:
                 print(
                     f"WARNING: replayed app hash {final.app_hash.hex()} != "
                     f"stored {stored.app_hash.hex()}",
@@ -243,10 +279,12 @@ def cmd_replay(args) -> int:
                         "app_height": info.last_block_height,
                         "app_hash": info.last_block_app_hash.hex(),
                         "state_app_hash": final.app_hash.hex(),
+                        "mismatch": mismatch,
                     }
                 )
             )
-            return 0
+            # scripted integrity checks must see divergence as failure
+            return 1 if mismatch else 0
         finally:
             await conns.stop()
 
@@ -302,13 +340,24 @@ def cmd_testnet(args) -> int:
 
     base = os.path.expanduser(args.output)
     n = args.validators
+    key_types = [
+        k.strip() for k in getattr(args, "key_types", "ed25519").split(",") if k
+    ]
     pvs, node_keys = [], []
     for i in range(n):
         home = os.path.join(base, f"node{i}")
         p = _paths(home)
         os.makedirs(p["config"], exist_ok=True)
         os.makedirs(p["data"], exist_ok=True)
-        pvs.append(FilePV.load_or_generate(p["pv_key"], p["pv_state"]))
+        if not os.path.exists(p["pv_key"]):
+            pvs.append(
+                FilePV.generate(
+                    p["pv_key"], p["pv_state"],
+                    key_type=key_types[i % len(key_types)],
+                )
+            )
+        else:
+            pvs.append(FilePV.load(p["pv_key"], p["pv_state"]))
         node_keys.append(_load_or_gen_node_key(p["node_key"]))
     doc = GenesisDoc(
         chain_id=args.chain_id or f"testnet-{os.urandom(3).hex()}",
@@ -504,6 +553,11 @@ def main(argv: list[str] | None = None) -> int:
     p_testnet.add_argument("--output", "-o", default="./testnet")
     p_testnet.add_argument("--chain-id", default="")
     p_testnet.add_argument("--base-port", type=int, default=26656)
+    p_testnet.add_argument(
+        "--key-types",
+        default="ed25519",
+        help="comma-separated validator key types, cycled (ed25519,secp256k1)",
+    )
     p_testnet.set_defaults(fn=cmd_testnet)
 
     sub.add_parser("show-node-id").set_defaults(fn=cmd_show_node_id)

@@ -69,6 +69,7 @@ class P2PConfig:
 
     laddr: str = "0.0.0.0:26656"
     persistent_peers: str = ""  # comma-separated tcp://id@host:port
+    seeds: str = ""  # seed nodes: dialed once for addresses, then drop
     max_connections: int = 16
     # flow-rate limits, bytes/sec per connection (reference
     # config/config.go SendRate/RecvRate, default 5.12 MB/s); 0 = unlimited
@@ -82,6 +83,9 @@ class RPCConfig:
 
     laddr: str = "127.0.0.1:26657"
     enable: bool = True
+    # serve /debug/pprof/* (reference pprof-laddr, config.go:529) —
+    # opt-in: profiling slows the event loop
+    pprof: bool = False
 
 
 @dataclass

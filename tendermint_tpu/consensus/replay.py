@@ -109,7 +109,30 @@ class Handshaker:
             self._assert_app_hash(state, app_hash)
             return state
 
-        # 2. sanity (reference replay.go checkAppHashEqualsOneFromState region)
+        # 2. fresh state + populated store + fresh app → full replay:
+        #    rebuild state by applying every stored block from the base
+        #    (reference replay.go:415-443 replays the whole span when the
+        #    app is behind the store; this is also what `replay` builds:
+        #    a genesis state, a fresh app, and the node's block store).
+        #    apply_block's header checks (app_hash chaining, last_block_id)
+        #    validate each step against the stored chain.
+        if state_height == 0 and app_height == 0 and store_height > 0:
+            if store_base > state.initial_height:
+                raise HandshakeError(
+                    f"cannot replay from genesis: store pruned to base {store_base}"
+                )
+            executor = BlockExecutor(self.state_store, app_conns.consensus)
+            for h in range(store_base, store_height + 1):
+                block = self.block_store.load_block(h)
+                meta = self.block_store.load_block_meta(h)
+                if block is None or meta is None:
+                    raise HandshakeError(f"missing block {h} in store")
+                self.logger.info("replaying block %d from genesis", h)
+                state, _ = await executor.apply_block(state, meta.block_id, block)
+                self.n_blocks_replayed += 1
+            return state
+
+        # 3. sanity (reference replay.go checkAppHashEqualsOneFromState region)
         if app_height > store_height:
             raise HandshakeError(
                 f"app height {app_height} ahead of store height {store_height}"
@@ -125,7 +148,7 @@ class Handshaker:
 
         executor = BlockExecutor(self.state_store, app_conns.consensus)
 
-        # 3. replay app-missing blocks up to store_height-1 via exec+commit
+        # 4. replay app-missing blocks up to store_height-1 via exec+commit
         #    (reference replayBlocks replay.go:528 region)
         replay_to = store_height - 1 if state_height == store_height - 1 else store_height
         for h in range(app_height + 1, replay_to + 1):
@@ -136,7 +159,7 @@ class Handshaker:
             app_hash = await executor.exec_commit_block(state, block)
             self.n_blocks_replayed += 1
 
-        # 4. if state lags the store by one, apply the tip block fully
+        # 5. if state lags the store by one, apply the tip block fully
         #    (crash happened between SaveBlock and ApplyBlock)
         if state_height == store_height - 1:
             block = self.block_store.load_block(store_height)

@@ -403,6 +403,12 @@ class ConsensusState(Service):
                 # sleep until the post-commit reset pulse
                 await self.mempool.wait_notified_reset()
                 continue
+            if self.mempool.size() == 0:
+                # raced a commit: between the txs-available wakeup and
+                # this resumption, mempool.update() drained the pool and
+                # reset the latch — firing now would propose an empty
+                # block despite create_empty_blocks=false
+                continue
             self.mempool.notified_txs_available = True
             await self.msg_queue.put(_TXS_AVAILABLE)
 

@@ -76,8 +76,46 @@ def register_pubkey_type(type_name: str, decoder) -> None:
 
 
 def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
+    if type_name not in _PUBKEY_DECODERS and type_name in (
+        "ed25519",
+        "secp256k1",
+        "sr25519",
+    ):
+        # decoders register at module import; pull in the builtin module
+        # for a known type on first use (a genesis doc with secp256k1
+        # validators must decode without the caller pre-importing it)
+        import importlib
+
+        importlib.import_module(f".{type_name}", __name__)
     try:
         dec = _PUBKEY_DECODERS[type_name]
     except KeyError:
         raise ValueError(f"unknown pubkey type {type_name!r}") from None
     return dec(data)
+
+
+# The reference's tendermint.crypto.PublicKey proto oneof field numbers
+# (proto/tendermint/crypto/keys.proto:13-17) — consensus-critical: the
+# validator-set hash merkles SimpleValidator encodings built on this.
+PUBKEY_PROTO_FIELD = {"ed25519": 1, "secp256k1": 2, "sr25519": 3}
+_PUBKEY_PROTO_TYPE = {v: k for k, v in PUBKEY_PROTO_FIELD.items()}
+
+
+def pubkey_to_proto(pub: PubKey) -> bytes:
+    """Serialize as the reference's PublicKey oneof message — byte-exact
+    (frozen against the reference's MBT vectors, tests/test_light_mbt.py)."""
+    from ..libs import protoenc as pe
+
+    return pe.bytes_field(PUBKEY_PROTO_FIELD[pub.TYPE], pub.bytes())
+
+
+def pubkey_from_proto(data: bytes) -> PubKey:
+    from ..libs import protoenc as pe
+
+    r = pe.Reader(data)
+    f, wt = r.read_tag()
+    try:
+        type_name = _PUBKEY_PROTO_TYPE[f]
+    except KeyError:
+        raise ValueError(f"unknown PublicKey oneof field {f}") from None
+    return pubkey_from_type_and_bytes(type_name, r.read_bytes())

@@ -120,6 +120,12 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
         from . import pallas_field
 
         return pallas_field.mul(a, b)
+    return _mul_gemm(a, b)
+
+
+def _mul_gemm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """The portable MXU GEMM formulation, reachable directly (bypassing
+    the _USE_PALLAS switch) so A/B probes can time both paths."""
     a, b = jnp.broadcast_arrays(a, b)
     af = a.astype(jnp.float32)
     bf = b.astype(jnp.float32)

@@ -23,6 +23,7 @@ from __future__ import annotations
 import hashlib
 import os
 from functools import partial
+from math import gcd as _gcd
 
 import numpy as np
 
@@ -146,12 +147,23 @@ def _ensure_compile_cache() -> None:
     _cache_ready = True
 
 
+#: Filled by _maybe_enable_pallas on TPU: timings of the two field-multiply
+#: formulations so benchmarks can record WHY a path was chosen instead of
+#: the probe picking silently. Keys: gemm_us, pallas_us, chosen.
+field_mul_probe: dict = {}
+
+
 def _maybe_enable_pallas() -> None:
-    """Route field multiplies through the Pallas VMEM kernel when the
-    backend can actually run it (probed with one tiny multiply, checked
-    against the GEMM path). TMTPU_NO_PALLAS=1 pins the portable path."""
+    """A/B the two field-multiply formulations on the live backend and
+    route through the faster one: the 0/1-matrix GEMM convolution (MXU,
+    ~64.5k routed MACs/element) vs the Pallas VMEM kernel (~64 VPU
+    MACs/element). Correctness is cross-checked before timing; the result
+    (both timings + the winner) is recorded in `field_mul_probe`.
+    TMTPU_NO_PALLAS=1 pins the GEMM path."""
     if os.environ.get("TMTPU_NO_PALLAS"):
         return
+    import time as _t
+
     import jax
 
     from . import field as F
@@ -168,10 +180,40 @@ def _maybe_enable_pallas() -> None:
             F.limbs_to_int(want[i]) == F.limbs_to_int(got[i]) for i in range(4)
         ):
             raise RuntimeError("pallas field mul mismatch")
-        F.set_pallas(True)
+
+        # time both at a realistic MSM batch width (8192 field elements)
+        big = np.random.default_rng(0).integers(0, 256, (8192, 32)).astype(np.int32)
+        gemm_mul = jax.jit(F._mul_gemm)
+        pall_mul = jax.jit(pallas_field.mul)
+
+        def _time(fn, reps=10):
+            out = fn(big, big)
+            jax.block_until_ready(out)  # compile + warm
+            t0 = _t.perf_counter()
+            for _ in range(reps):
+                out = fn(big, big)
+            jax.block_until_ready(out)
+            return (_t.perf_counter() - t0) / reps * 1e6
+
+        gemm_us = _time(gemm_mul)
+        pallas_us = _time(pall_mul)
+        use_pallas = pallas_us < gemm_us
+        field_mul_probe.update(
+            gemm_us=round(gemm_us, 1),
+            pallas_us=round(pallas_us, 1),
+            chosen="pallas" if use_pallas else "gemm",
+        )
+        import logging
+
+        logging.getLogger("crypto.tpu").info(
+            "field-mul A/B (8192-wide): gemm %.1fus pallas %.1fus -> %s",
+            gemm_us, pallas_us, field_mul_probe["chosen"],
+        )
+        F.set_pallas(use_pallas)
     except Exception as e:  # noqa: BLE001 — GEMM path keeps working
         import logging
 
+        field_mul_probe.setdefault("error", repr(e))
         logging.getLogger("crypto.tpu").info(
             "pallas field kernel unavailable (%r); using GEMM path", e
         )
@@ -240,7 +282,10 @@ def make_sharded_kernel_eq(mesh, axis: str = "data"):
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
 
     from . import curve, msm
     from .curve import Point
@@ -424,6 +469,38 @@ def prepare_batch_eq(entries: list[ResolvedSig | None], pad_to: int = 0):
     )
 
 
+def _shard_device_count() -> int:
+    """How many local devices the sharded kernels may span: the largest
+    power-of-two prefix of jax.devices() (the partial-point tree reduction
+    and bucket padding both want a power of two; real TPU topologies are).
+    TMTPU_NO_SHARDED=1 pins the single-device path."""
+    if os.environ.get("TMTPU_NO_SHARDED"):
+        return 1
+    try:
+        import jax
+
+        n = len(jax.devices())
+    except Exception:  # noqa: BLE001 — backend not up yet
+        return 1
+    if n <= 1:
+        return 1
+    return n if n & (n - 1) == 0 else 1 << (n.bit_length() - 1)
+
+
+def _get_sharded(n_dev: int):
+    """(batch-equation kernel, per-signature fallback kernel) jitted over
+    an n_dev 1-D mesh; cached per device count."""
+    kernels = _sharded_kernels.get(n_dev)
+    if kernels is None:
+        import jax
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("data",))
+        kernels = (make_sharded_kernel_eq(mesh), make_sharded_kernel(mesh))
+        _sharded_kernels[n_dev] = kernels
+    return kernels
+
+
 def verify_resolved(
     entries: list[ResolvedSig | None], pad_multiple: int = 1
 ) -> np.ndarray:
@@ -431,17 +508,33 @@ def verify_resolved(
     bool bitmap of length len(entries). The happy path (all signatures
     valid) costs one MSM kernel call; a failed equation falls back to the
     per-signature kernel to recover the bitmap (the reference bisects
-    inside voi; attribution cost only matters on the rare bad batch)."""
+    inside voi; attribution cost only matters on the rare bad batch).
+
+    Multi-device: when more than one accelerator is visible and the batch
+    is large enough that every shard still fills a floor bucket, the MSM
+    runs sharded over a 1-D mesh (one partial point gathered per device —
+    the only collective); padding rounds the batch up to a mesh-divisible
+    bucket. TMTPU_FORCE_SHARDED=1 drops the size gate (tests);
+    TMTPU_NO_SHARDED=1 disables sharding. One interface regardless of
+    topology — the reference's crypto/crypto.go:46-54 contract."""
     n = len(entries)
     if n == 0:
         return np.zeros(0, bool)
-    b = _bucket(n, pad_multiple)
-    bitmap, eq_ok = _get_kernel_eq()(*prepare_batch_eq(entries, pad_to=b))
+    n_dev = _shard_device_count()
+    use_sharded = n_dev > 1 and (
+        os.environ.get("TMTPU_FORCE_SHARDED") == "1" or n >= _MIN_BUCKET * n_dev
+    )
+    if use_sharded:
+        mult = pad_multiple * n_dev // _gcd(pad_multiple, n_dev)
+        b = _bucket(n, mult)
+        kernel_eq, kernel_sig = _get_sharded(n_dev)
+    else:
+        b = _bucket(n, pad_multiple)
+        kernel_eq, kernel_sig = _get_kernel_eq(), _get_kernel()
+    bitmap, eq_ok = kernel_eq(*prepare_batch_eq(entries, pad_to=b))
     if bool(eq_ok):
         return np.asarray(bitmap)[:n]
-    out = np.asarray(
-        _get_kernel()(*prepare_resolved(entries, pad_to=b))
-    )
+    out = np.asarray(kernel_sig(*prepare_resolved(entries, pad_to=b)))
     return out[:n]
 
 

@@ -162,7 +162,15 @@ class LightProxyEnv:
         )
         resp = res["response"]
         if int(resp.get("code", 0)) != 0:
-            return res  # app-level miss; nothing to verify
+            # App-level miss: the kvstore merkle tree has no absence
+            # proofs (neighbor-leaf range proofs), so a "does not exist"
+            # answer CANNOT be verified — a malicious primary could censor
+            # any key by answering not-found. Surface that explicitly so
+            # callers never mistake a miss for a proven absence (the
+            # reference's iavl store proves absence; this one can't).
+            resp["proof_verified"] = False
+            resp["proof_unavailable"] = "negative results carry no absence proof"
+            return res
         q_height = int(resp["height"])
         ops = [
             merkle.ProofOp(

@@ -140,12 +140,16 @@ def verify(
     trusting_period_ns: int,
     now_ns: int | None = None,
     trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+    max_clock_drift_ns: int = 10 * 1_000_000_000,
 ) -> None:
     """Dispatch on adjacency (reference Verify verifier.go:151)."""
     if untrusted.height == trusted.height + 1:
-        verify_adjacent(chain_id, trusted, untrusted, trusting_period_ns, now_ns)
+        verify_adjacent(
+            chain_id, trusted, untrusted, trusting_period_ns, now_ns,
+            max_clock_drift_ns,
+        )
     else:
         verify_non_adjacent(
             chain_id, trusted, untrusted, trusting_period_ns, now_ns,
-            trust_level=trust_level,
+            max_clock_drift_ns, trust_level=trust_level,
         )

@@ -77,6 +77,8 @@ class NodeConfig:
     wal_dir: str = ""
     # RPC listen address, e.g. "127.0.0.1:26657"; empty disables RPC
     rpc_laddr: str = ""
+    # serve /debug/pprof/* on the RPC port (opt-in; see RPCConfig.pprof)
+    rpc_pprof: bool = False
     tx_index: bool = True
     # seed mode (reference node/node.go:490 makeSeedNode): run ONLY the
     # p2p layer + PEX crawler, serving addresses and hanging up — no app,
@@ -107,7 +109,9 @@ class Node(Service):
         super().__init__("node", logger)
         self.config = config
         self.genesis = genesis
-        self.app_conns = AppConns.local(app)
+        # `app` may be an in-process Application or a pre-built AppConns
+        # (socket/gRPC attachment — reference proxy_app tcp://…, grpc://…)
+        self.app_conns = app if isinstance(app, AppConns) else AppConns.local(app)
         self.node_key = node_key
         self.node_id = node_id_from_pubkey(node_key.pub_key())
         self.priv_validator = priv_validator
@@ -356,7 +360,7 @@ class Node(Service):
                 node_info=self.node_info,
                 metrics=self.metrics,
             )
-            self.rpc_server = RPCServer(env)
+            self.rpc_server = RPCServer(env, enable_pprof=self.config.rpc_pprof)
             host, _, port = self.config.rpc_laddr.rpartition(":")
             await self.rpc_server.start(host or "127.0.0.1", int(port or 0))
         if (

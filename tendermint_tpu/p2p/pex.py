@@ -106,12 +106,13 @@ class PexReactor(Service):
 
         known = self.peer_manager.all_known()[:MAX_ADDRESSES]
         addrs = tuple(str(a) for a in known if a.node_id != node_id)
-        try:
-            self.channel.out_q.put_nowait(
-                Envelope(PEX_CHANNEL, PexResponse(addrs), to=node_id)
-            )
-        except _a.QueueFull:
-            pass
+        # blocking put: the seed exists to deliver addresses — dropping the
+        # push under load and then hanging up would disconnect the peer
+        # having taught it nothing. The disconnect timer starts after
+        # delivery.
+        await self.channel.out_q.put(
+            Envelope(PEX_CHANNEL, PexResponse(addrs), to=node_id)
+        )
         await _a.sleep(self.seed_disconnect_after)
         if node_id in self.peers:
             await self.channel.error(

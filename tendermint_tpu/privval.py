@@ -182,8 +182,18 @@ class FilePV(PrivValidator):
     # -- construction ----------------------------------------------------
 
     @classmethod
-    def generate(cls, key_path: str, state_path: str) -> "FilePV":
-        pv = cls(ed25519.Ed25519PrivKey.generate(), key_path, state_path)
+    def generate(
+        cls, key_path: str, state_path: str, *, key_type: str = "ed25519"
+    ) -> "FilePV":
+        if key_type == "ed25519":
+            priv = ed25519.Ed25519PrivKey.generate()
+        elif key_type == "secp256k1":
+            from .crypto import secp256k1
+
+            priv = secp256k1.Secp256k1PrivKey.generate()
+        else:
+            raise ValueError(f"unsupported validator key type {key_type!r}")
+        pv = cls(priv, key_path, state_path)
         pv.save()
         return pv
 

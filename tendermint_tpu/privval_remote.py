@@ -94,31 +94,42 @@ class SignerServer:
             writer.close()
 
     def _handle(self, tag: int, body: bytes) -> bytes:
-        if tag == T_PUBKEY_REQ:
-            return _encode(
-                T_PUBKEY_RES, pe.bytes_field(1, self.pv.get_pub_key().bytes())
-            )
-        if tag in (T_SIGN_VOTE_REQ, T_SIGN_PROPOSAL_REQ):
-            r = pe.Reader(body)
-            chain_id, raw = "", b""
-            while not r.eof():
-                f, wt = r.read_tag()
-                if f == 1:
-                    chain_id = r.read_string()
-                elif f == 2:
-                    raw = r.read_bytes()
-                else:
-                    r.skip(wt)
-            try:
-                if tag == T_SIGN_VOTE_REQ:
-                    signed = self.pv.sign_vote(chain_id, Vote.decode(raw))
-                    return _encode(T_SIGN_VOTE_RES, pe.bytes_field(1, signed.encode()))
-                signed = self.pv.sign_proposal(chain_id, Proposal.decode(raw))
-                return _encode(T_SIGN_PROPOSAL_RES, pe.bytes_field(1, signed.encode()))
-            except DoubleSignError as e:
-                res_tag = T_SIGN_VOTE_RES if tag == T_SIGN_VOTE_REQ else T_SIGN_PROPOSAL_RES
-                return _encode(res_tag, pe.string_field(2, str(e)))
-        return _encode(tag + 1, pe.string_field(2, f"unknown request {tag}"))
+        return _encode(*handle_signer_request(self.pv, tag, body))
+
+
+def handle_signer_request(
+    pv: PrivValidator, tag: int, body: bytes
+) -> tuple[int, bytes]:
+    """Transport-independent signer dispatch: (request tag, body) →
+    (response tag, body). Shared by the socket and gRPC servers so the
+    two attachment modes answer identically."""
+    if tag == T_PUBKEY_REQ:
+        # typed PublicKey proto, not raw bytes: remote signers may hold
+        # non-ed25519 keys (reference privval proto carries the oneof)
+        from .crypto import pubkey_to_proto
+
+        return T_PUBKEY_RES, pe.bytes_field(1, pubkey_to_proto(pv.get_pub_key()))
+    if tag in (T_SIGN_VOTE_REQ, T_SIGN_PROPOSAL_REQ):
+        r = pe.Reader(body)
+        chain_id, raw = "", b""
+        while not r.eof():
+            f, wt = r.read_tag()
+            if f == 1:
+                chain_id = r.read_string()
+            elif f == 2:
+                raw = r.read_bytes()
+            else:
+                r.skip(wt)
+        res_tag = T_SIGN_VOTE_RES if tag == T_SIGN_VOTE_REQ else T_SIGN_PROPOSAL_RES
+        try:
+            if tag == T_SIGN_VOTE_REQ:
+                signed = pv.sign_vote(chain_id, Vote.decode(raw))
+            else:
+                signed = pv.sign_proposal(chain_id, Proposal.decode(raw))
+            return res_tag, pe.bytes_field(1, signed.encode())
+        except DoubleSignError as e:
+            return res_tag, pe.string_field(2, str(e))
+    return tag + 1, pe.string_field(2, f"unknown request {tag}")
 
 
 class ThreadedSignerServer:
@@ -250,9 +261,11 @@ class SignerClient(PrivValidator):
 
     def get_pub_key(self):
         if self._pub_key is None:
+            from .crypto import pubkey_from_proto
+
             tag, body = self._roundtrip(T_PUBKEY_REQ, b"")
             raw, _err = self._parse_signed(body)
-            self._pub_key = ed25519.Ed25519PubKey(raw)
+            self._pub_key = pubkey_from_proto(raw)
         return self._pub_key
 
     def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
@@ -267,6 +280,118 @@ class SignerClient(PrivValidator):
         body = pe.string_field(1, chain_id) + pe.bytes_field(2, proposal.encode())
         _tag, res = self._roundtrip(T_SIGN_PROPOSAL_REQ, body)
         raw, err = self._parse_signed(res)
+        if err:
+            raise DoubleSignError(err)
+        return Proposal.decode(raw)
+
+
+# -- gRPC attachment mode (reference privval/grpc/{server,client}.go) -------
+
+GRPC_SIGNER_SERVICE = "tendermint.privval.PrivValidatorAPI"
+_GRPC_METHOD_TAGS = {
+    "GetPubKey": T_PUBKEY_REQ,
+    "SignVote": T_SIGN_VOTE_REQ,
+    "SignProposal": T_SIGN_PROPOSAL_REQ,
+}
+
+
+class GrpcSignerServer:
+    """Serves a PrivValidator over gRPC (reference privval/grpc/server.go:1).
+    Payload bodies are the same protoenc encodings as the socket protocol
+    (handle_signer_request), so the two modes answer identically; gRPC
+    provides the framing, deadlines, and connection management."""
+
+    def __init__(self, pv: PrivValidator):
+        self.pv = pv
+        self._server = None
+        self.port: int | None = None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        from concurrent import futures
+
+        import grpc
+
+        # one worker: the reference serializes signing (the double-sign
+        # guard mutates last-sign state; concurrent signs must not race)
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=1))
+
+        def make_handler(tag: int):
+            def handle(request: bytes, context) -> bytes:
+                res_tag, body = handle_signer_request(self.pv, tag, request)
+                return pe.message_field(res_tag, body)
+
+            return handle
+
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                make_handler(tag),
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            )
+            for name, tag in _GRPC_METHOD_TAGS.items()
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(GRPC_SIGNER_SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self._server.start()
+        return self.port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=1.0)
+
+
+class GrpcSignerClient(PrivValidator):
+    """PrivValidator over a blocking gRPC channel (reference
+    privval/grpc/client.go:1). Consensus signs synchronously, so the
+    sync API is the right shape — no event-loop involvement."""
+
+    def __init__(self, host: str, port: int, *, timeout: float = 3.0):
+        import grpc
+
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(f"{host}:{port}")
+        self._stubs = {
+            name: self._channel.unary_unary(
+                f"/{GRPC_SIGNER_SERVICE}/{name}",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b,
+            )
+            for name in _GRPC_METHOD_TAGS
+        }
+        self._pub_key: ed25519.Ed25519PubKey | None = None
+
+    def close(self) -> None:
+        self._channel.close()
+
+    def _roundtrip(self, method: str, body: bytes) -> bytes:
+        payload = self._stubs[method](body, timeout=self.timeout)
+        _tag, res = _decode(payload)
+        return res
+
+    def get_pub_key(self):
+        if self._pub_key is None:
+            from .crypto import pubkey_from_proto
+
+            raw, _err = SignerClient._parse_signed(
+                self._roundtrip("GetPubKey", b"")
+            )
+            self._pub_key = pubkey_from_proto(raw)
+        return self._pub_key
+
+    def sign_vote(self, chain_id: str, vote: Vote) -> Vote:
+        body = pe.string_field(1, chain_id) + pe.bytes_field(2, vote.encode())
+        raw, err = SignerClient._parse_signed(self._roundtrip("SignVote", body))
+        if err:
+            raise DoubleSignError(err)
+        return Vote.decode(raw)
+
+    def sign_proposal(self, chain_id: str, proposal: Proposal) -> Proposal:
+        body = pe.string_field(1, chain_id) + pe.bytes_field(2, proposal.encode())
+        raw, err = SignerClient._parse_signed(
+            self._roundtrip("SignProposal", body)
+        )
         if err:
             raise DoubleSignError(err)
         return Proposal.decode(raw)

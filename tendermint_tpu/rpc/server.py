@@ -43,6 +43,7 @@ class RPCServer:
         self,
         env: Environment,
         *,
+        enable_pprof: bool = False,
         logger: logging.Logger | None = None,
     ):
         self.env = env
@@ -51,6 +52,14 @@ class RPCServer:
         self.app.router.add_post("/", self._handle_jsonrpc)
         self.app.router.add_get("/websocket", self._handle_ws)
         self.app.router.add_get("/metrics", self._handle_metrics)
+        if enable_pprof:
+            # live profiling over HTTP — opt-in, like the reference which
+            # only serves Go pprof when pprof-laddr is explicitly set
+            # (config/config.go:529-530): profiling slows the event loop,
+            # so it must never be reachable by default
+            self.app.router.add_get("/debug/pprof/profile", self._handle_profile)
+            self.app.router.add_get("/debug/pprof/heap", self._handle_heap)
+            self.app.router.add_get("/debug/pprof/stacks", self._handle_stacks)
         for name in ROUTES:
             self.app.router.add_get(f"/{name}", self._make_uri_handler(name))
         self._runner: web.AppRunner | None = None
@@ -78,6 +87,83 @@ class RPCServer:
         return web.Response(
             text=metrics.render(), content_type="text/plain", charset="utf-8"
         )
+
+    # -- live profiling (reference pprof-laddr, config/config.go:529) ----
+
+    _profiling = False
+
+    async def _handle_profile(self, request: web.Request) -> web.Response:
+        """CPU profile of the event-loop thread for ?seconds=N (default 5):
+        the hot node's consensus/verification work all runs on this loop,
+        so this is the profile that matters. One at a time."""
+        import cProfile
+        import io
+        import pstats
+
+        import math
+
+        if RPCServer._profiling:
+            return web.Response(status=429, text="profile already running\n")
+        try:
+            seconds = float(request.query.get("seconds", "5"))
+        except ValueError:
+            return web.Response(status=400, text="bad seconds\n")
+        # NaN poisons min() AND asyncio.sleep (never fires, leaving the
+        # profiler enabled forever) — require a finite positive window
+        if not math.isfinite(seconds) or not 0 < seconds <= 120:
+            return web.Response(status=400, text="seconds must be in (0, 120]\n")
+        RPCServer._profiling = True
+        prof = cProfile.Profile()
+        try:
+            prof.enable()
+            await asyncio.sleep(seconds)
+        finally:
+            prof.disable()
+            RPCServer._profiling = False
+        buf = io.StringIO()
+        stats = pstats.Stats(prof, stream=buf)
+        stats.sort_stats("cumulative").print_stats(60)
+        return web.Response(text=buf.getvalue(), content_type="text/plain")
+
+    async def _handle_heap(self, request: web.Request) -> web.Response:
+        """Heap allocation snapshot via tracemalloc. First call arms
+        tracing and returns a baseline notice; later calls report top
+        allocation sites since then (?top=N, default 40)."""
+        import tracemalloc
+
+        if request.query.get("op") == "stop":
+            tracemalloc.stop()
+            return web.Response(text="tracemalloc disarmed\n", content_type="text/plain")
+        top = min(int(request.query.get("top", "40")), 200)
+        if not tracemalloc.is_tracing():
+            tracemalloc.start(10)
+            return web.Response(
+                text="tracemalloc armed; call again for a snapshot\n",
+                content_type="text/plain",
+            )
+        snap = tracemalloc.take_snapshot()
+        lines = [
+            f"heap snapshot: {len(snap.traces)} traces, "
+            f"current={tracemalloc.get_traced_memory()[0]:,}B "
+            f"peak={tracemalloc.get_traced_memory()[1]:,}B",
+        ]
+        for stat in snap.statistics("lineno")[:top]:
+            lines.append(str(stat))
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def _handle_stacks(self, request: web.Request) -> web.Response:
+        """All-thread stack dump (goroutine-dump analog)."""
+        import sys
+        import threading
+        import traceback
+
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = []
+        for ident, frame in sys._current_frames().items():
+            out.append(f"Thread {names.get(ident, '?')} ({ident}):")
+            out.extend(l.rstrip() for l in traceback.format_stack(frame))
+            out.append("")
+        return web.Response(text="\n".join(out), content_type="text/plain")
 
     # -- dispatch --------------------------------------------------------
 

@@ -1,0 +1,224 @@
+"""Relational event sink — the reference's psql sink re-homed on DB-API
+(reference internal/state/indexer/sink/psql/psql.go:1 and its
+schema.sql: blocks / tx_results / events / attributes).
+
+The schema and write shapes mirror the reference's PostgreSQL sink; the
+driver is any DB-API connection. `SQLEventSink.sqlite(path)` is the
+always-available embedded form (":memory:" for tests);
+`SQLEventSink.postgres(dsn)` attaches to PostgreSQL when psycopg2 is
+installed (not in this image — gated, same contract).
+
+Implements the same sink interface as KVSink (index_tx / index_block /
+get_tx / search_txs / search_blocks), so IndexerService and the RPC
+search routes take either."""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ..libs.pubsub import Query
+from .indexer import TxResult
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  height     BIGINT NOT NULL,
+  chain_id   VARCHAR NOT NULL,
+  created_at TIMESTAMP NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+  tx_index   INTEGER NOT NULL,
+  created_at TIMESTAMP NOT NULL,
+  tx_hash    VARCHAR NOT NULL,
+  tx_result  BLOB NOT NULL,
+  UNIQUE (block_id, tx_index)
+);
+CREATE TABLE IF NOT EXISTS events (
+  rowid    INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+  tx_id    BIGINT REFERENCES tx_results(rowid),
+  type     VARCHAR NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      BIGINT NOT NULL REFERENCES events(rowid),
+  key           VARCHAR NOT NULL,
+  composite_key VARCHAR NOT NULL,
+  value         VARCHAR
+);
+CREATE INDEX IF NOT EXISTS idx_attributes_composite
+  ON attributes (composite_key, value);
+CREATE INDEX IF NOT EXISTS idx_tx_hash ON tx_results (tx_hash);
+"""
+
+
+class SQLEventSink:
+    def __init__(self, conn, chain_id: str = "", *, paramstyle: str = "qmark"):
+        self.conn = conn
+        self.chain_id = chain_id
+        self._ph = "?" if paramstyle == "qmark" else "%s"
+        cur = self.conn.cursor()
+        for stmt in _SCHEMA.strip().split(";"):
+            if stmt.strip():
+                cur.execute(stmt)
+        self.conn.commit()
+
+    # -- constructors ----------------------------------------------------
+
+    @classmethod
+    def sqlite(cls, path: str = ":memory:", chain_id: str = "") -> "SQLEventSink":
+        import sqlite3
+
+        conn = sqlite3.connect(path)
+        return cls(conn, chain_id, paramstyle="qmark")
+
+    @classmethod
+    def postgres(cls, dsn: str, chain_id: str = "") -> "SQLEventSink":
+        """Reference parity mode; requires psycopg2 (not bundled here)."""
+        try:
+            import psycopg2  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "postgres sink requires psycopg2; use SQLEventSink.sqlite"
+            ) from e
+        import psycopg2
+
+        conn = psycopg2.connect(dsn)
+        return cls(conn, chain_id, paramstyle="format")
+
+    # -- helpers ---------------------------------------------------------
+
+    def _exec(self, sql: str, args: tuple = ()):
+        cur = self.conn.cursor()
+        cur.execute(sql.replace("?", self._ph), args)
+        return cur
+
+    def _block_rowid(self, height: int) -> int:
+        cur = self._exec(
+            "SELECT rowid FROM blocks WHERE height = ? AND chain_id = ?",
+            (height, self.chain_id),
+        )
+        row = cur.fetchone()
+        if row is not None:
+            return row[0]
+        cur = self._exec(
+            "INSERT INTO blocks (height, chain_id, created_at) VALUES (?, ?, ?)",
+            (height, self.chain_id, time.time()),
+        )
+        return cur.lastrowid
+
+    def _insert_events(
+        self, block_id: int, tx_id: int | None, events: dict[str, list[str]]
+    ) -> None:
+        for composite, values in events.items():
+            etype, _, key = composite.rpartition(".")
+            for v in values:
+                cur = self._exec(
+                    "INSERT INTO events (block_id, tx_id, type) VALUES (?, ?, ?)",
+                    (block_id, tx_id, etype),
+                )
+                self._exec(
+                    "INSERT INTO attributes (event_id, key, composite_key, value)"
+                    " VALUES (?, ?, ?, ?)",
+                    (cur.lastrowid, key, composite, v),
+                )
+
+    # -- sink interface --------------------------------------------------
+
+    def index_tx(self, res: TxResult) -> None:
+        bid = self._block_rowid(res.height)
+        cur = self._exec(
+            "INSERT OR REPLACE INTO tx_results"
+            " (block_id, tx_index, created_at, tx_hash, tx_result)"
+            " VALUES (?, ?, ?, ?, ?)",
+            (bid, res.index, time.time(), res.hash.hex().upper(), res.to_json()),
+        )
+        tx_id = cur.lastrowid
+        events = dict(res.events)
+        events.setdefault("tx.height", [str(res.height)])
+        events.setdefault("tx.hash", [res.hash.hex().upper()])
+        self._insert_events(bid, tx_id, events)
+        self.conn.commit()
+
+    def index_block(self, height: int, events: dict[str, list[str]]) -> None:
+        bid = self._block_rowid(height)
+        evmap = dict(events)
+        evmap.setdefault("block.height", [str(height)])
+        self._insert_events(bid, None, evmap)
+        self.conn.commit()
+
+    # -- reads -----------------------------------------------------------
+
+    def get_tx(self, hash_: bytes) -> TxResult | None:
+        cur = self._exec(
+            "SELECT tx_result FROM tx_results WHERE tx_hash = ?",
+            (hash_.hex().upper(),),
+        )
+        row = cur.fetchone()
+        return TxResult.from_json(row[0]) if row else None
+
+    def _events_for_tx(self, tx_id: int) -> dict[str, list[str]]:
+        cur = self._exec(
+            "SELECT a.composite_key, a.value FROM attributes a"
+            " JOIN events e ON a.event_id = e.rowid WHERE e.tx_id = ?",
+            (tx_id,),
+        )
+        out: dict[str, list[str]] = {}
+        for ck, v in cur.fetchall():
+            out.setdefault(ck, []).append(v)
+        return out
+
+    def search_txs(self, query: Query, limit: int = 100) -> list[TxResult]:
+        # narrow by the first equality condition through the attributes
+        # index (the reference composes SQL joins the same way)
+        eq = next(
+            (c for c in query.conditions if c.op == "=" and c.key != "tm.event"),
+            None,
+        )
+        if eq is not None:
+            cur = self._exec(
+                "SELECT DISTINCT t.rowid, t.tx_result FROM tx_results t"
+                " JOIN events e ON e.tx_id = t.rowid"
+                " JOIN attributes a ON a.event_id = e.rowid"
+                " WHERE a.composite_key = ? AND a.value = ?",
+                (eq.key, str(eq.operand)),
+            )
+        else:
+            cur = self._exec("SELECT rowid, tx_result FROM tx_results", ())
+        out = []
+        for tx_id, raw in cur.fetchall():
+            res = TxResult.from_json(raw)
+            evmap = self._events_for_tx(tx_id)
+            evmap.setdefault("tx.height", [str(res.height)])
+            evmap.setdefault("tx.hash", [res.hash.hex().upper()])
+            if query.matches(evmap):
+                out.append(res)
+                if len(out) >= limit:
+                    break
+        out.sort(key=lambda r: (r.height, r.index))
+        return out
+
+    def search_blocks(self, query: Query, limit: int = 100) -> list[int]:
+        cur = self._exec(
+            "SELECT b.height, b.rowid FROM blocks b ORDER BY b.height", ()
+        )
+        out = []
+        for height, bid in cur.fetchall():
+            ecur = self._exec(
+                "SELECT a.composite_key, a.value FROM attributes a"
+                " JOIN events e ON a.event_id = e.rowid"
+                " WHERE e.block_id = ? AND e.tx_id IS NULL",
+                (bid,),
+            )
+            evmap: dict[str, list[str]] = {}
+            for ck, v in ecur.fetchall():
+                evmap.setdefault(ck, []).append(v)
+            evmap.setdefault("block.height", [str(height)])
+            if query.matches(evmap):
+                out.append(height)
+                if len(out) >= limit:
+                    break
+        return out

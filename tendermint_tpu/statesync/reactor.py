@@ -39,18 +39,22 @@ from . import messages as m
 DISCOVERY_TIME = 2.0
 CHUNK_TIMEOUT = 10.0
 CHUNK_FETCHERS = 4
-BACKFILL_BLOCKS = 32  # how many recent headers to backfill after restore
 
 
 @dataclass(frozen=True)
 class SyncConfig:
     """Trust anchor for the state provider (reference config
-    statesync section: trust-height/trust-hash/trust-period)."""
+    statesync section: trust-height/trust-hash/trust-period).
+
+    backfill_blocks: explicit backfill depth override (tests); None (the
+    default) derives the depth from the chain's evidence params — far
+    enough back that any non-expired evidence remains verifiable
+    (reference internal/statesync/reactor.go:348-369)."""
 
     trust_height: int
     trust_hash: bytes
     trust_period_ns: int = 7 * 24 * 3600 * 10**9
-    backfill_blocks: int = BACKFILL_BLOCKS
+    backfill_blocks: int | None = None
 
 
 class SyncAbortedError(RuntimeError):
@@ -376,7 +380,17 @@ class StateSyncReactor(Service):
         )
         self.block_store.save_seen_commit(h, lb_h.signed_header.commit)
 
-        await self._backfill(lb_h, config.backfill_blocks)
+        # backfill depth: explicit override, or the evidence window — any
+        # evidence younger than BOTH expiry dimensions must stay verifiable
+        # (reference reactor.go:348-369 backfills to max-age, not a constant)
+        if config.backfill_blocks is not None:
+            stop_height = h - config.backfill_blocks
+            stop_time_ns = lb_h.header.time_ns  # height-driven only
+        else:
+            ev = params.evidence
+            stop_height = h - ev.max_age_num_blocks
+            stop_time_ns = lb_h.header.time_ns - ev.max_age_duration_ns
+        await self._backfill(lb_h, stop_height, stop_time_ns)
         self.logger.info("state sync complete at height %d", h)
         return state
 
@@ -398,13 +412,20 @@ class StateSyncReactor(Service):
         self.logger.warning("no peer served consensus params; using defaults")
         return ConsensusParams()
 
-    async def _backfill(self, from_lb: LightBlock, n: int) -> None:
+    async def _backfill(
+        self, from_lb: LightBlock, stop_height: int, stop_time_ns: int
+    ) -> None:
         """Reverse-fetch recent headers, verified by hash-chain linkage
-        (reference Backfill reactor.go:348,481-486 — NOT signatures)."""
+        (reference Backfill reactor.go:348,481-486 — NOT signatures).
+        Fetches until the current header is outside BOTH evidence-expiry
+        dimensions (height ≤ stop_height and time ≤ stop_time_ns), the
+        chain's base, or history runs out on every peer."""
         cur = from_lb
-        for _ in range(n):
+        while True:
+            if cur.height <= stop_height and cur.header.time_ns <= stop_time_ns:
+                break
             prev_height = cur.height - 1
-            if prev_height < 1:
+            if prev_height < max(1, self.initial_height):
                 break
             try:
                 prev = await self.dispatcher.light_block(prev_height)

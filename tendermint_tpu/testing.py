@@ -23,9 +23,30 @@ def det_priv_keys(n: int, seed: bytes = b"tmtpu-test") -> list[ed25519.Ed25519Pr
 
 
 def make_validator_set(
-    n: int, power: int = 10, seed: bytes = b"tmtpu-test"
-) -> tuple[ValidatorSet, dict[bytes, ed25519.Ed25519PrivKey]]:
-    keys = det_priv_keys(n, seed)
+    n: int,
+    power: int = 10,
+    seed: bytes = b"tmtpu-test",
+    key_types: tuple[str, ...] = ("ed25519",),
+) -> tuple[ValidatorSet, dict[bytes, object]]:
+    """Deterministic validator set; `key_types` cycles over the validators
+    (e.g. ("ed25519", "secp256k1") alternates key types — the BASELINE
+    config-4 mixed-set shape)."""
+    keys: list = []
+    for i in range(n):
+        kt = key_types[i % len(key_types)]
+        secret = hashlib.sha256(seed + kt.encode() + i.to_bytes(4, "big")).digest()
+        if kt == "ed25519":
+            keys.append(ed25519.Ed25519PrivKey(secret))
+        elif kt == "secp256k1":
+            from .crypto.secp256k1 import Secp256k1PrivKey
+
+            keys.append(Secp256k1PrivKey(secret))
+        elif kt == "sr25519":
+            from .crypto.sr25519 import Sr25519PrivKey
+
+            keys.append(Sr25519PrivKey(secret))
+        else:
+            raise ValueError(f"unknown key type {kt}")
     vals = ValidatorSet([Validator(k.pub_key(), power) for k in keys])
     by_addr = {k.pub_key().address(): k for k in keys}
     return vals, by_addr
@@ -66,6 +87,160 @@ def make_commit(
         else:
             sigs.append(CommitSig.for_block(val.address, ts, sig))
     return Commit(height, round_, block_id, tuple(sigs))
+
+
+async def build_kvstore_chain(n_blocks: int, n_vals: int, chain_id: str = "ss-bench"):
+    """Build an n_blocks kvstore chain through the real executor: returns
+    (block_store, state_store, app_conns, genesis, keys_by_addr) with the
+    app holding its periodic snapshots. Shared by bench.py config 5 and
+    the statesync tests."""
+    from .abci.kvstore import KVStoreApp
+    from .consensus.replay import Handshaker
+    from .proxy import AppConns
+    from .state.execution import BlockExecutor
+    from .state.state import state_from_genesis
+    from .state.store import StateStore
+    from .store.blockstore import BlockStore
+    from .store.db import MemDB
+    from .types.genesis import GenesisDoc, GenesisValidator
+
+    keys = det_priv_keys(n_vals)
+    gvals = [GenesisValidator(k.pub_key(), 10, f"v{i}") for i, k in enumerate(keys)]
+    genesis = GenesisDoc(
+        chain_id=chain_id,
+        initial_height=1,
+        genesis_time_ns=1_700_000_000_000_000_000,
+        validators=gvals,
+    )
+    by_addr = {k.pub_key().address(): k for k in keys}
+    app = KVStoreApp()
+    conns = AppConns.local(app)
+    bstore = BlockStore(MemDB())
+    sstore = StateStore(MemDB())
+    state = state_from_genesis(genesis)
+    state = await Handshaker(sstore, state, bstore, genesis).handshake(conns)
+    sstore.save(state)
+    ex = BlockExecutor(sstore, conns.consensus, block_store=bstore)
+    from .config import MempoolConfig
+    from .mempool.pool import PriorityMempool
+
+    mp = PriorityMempool(MempoolConfig(), conns.mempool)
+    ex.mempool = mp
+    commit = None
+    for h in range(1, n_blocks + 1):
+        if h % 3 == 1:
+            await mp.check_tx(b"k%d=v%d" % (h, h))
+        block, parts = ex.create_proposal_block(
+            h, state, commit, state.validators.get_proposer().address
+        )
+        bid = block.block_id(parts.header)
+        state, _ = await ex.apply_block(state, bid, block)
+        commit = make_commit(
+            chain_id, h, 0, bid, state.last_validators, by_addr,
+            timestamp_ns=block.header.time_ns + 1,
+        )
+        bstore.save_block(block, parts, commit)
+    return bstore, sstore, conns, genesis, by_addr
+
+
+async def statesync_restore_scenario(
+    n_blocks: int, n_vals: int, *, backfill_blocks: int | None = None
+) -> int:
+    """BASELINE config 5 shape: snapshot restore + verified backfill over
+    the real statesync reactor protocol, two reactors bridged in-process.
+    Returns the number of headers the restored node holds afterwards
+    (reference internal/statesync/reactor.go Sync + Backfill)."""
+    import asyncio
+
+    from .abci.kvstore import KVStoreApp
+    from .p2p.peermanager import PeerStatus, PeerUpdate
+    from .p2p.router import Channel
+    from .p2p.types import Envelope
+    from .proxy import AppConns
+    from .state.store import StateStore
+    from .statesync import (
+        CHUNK_CHANNEL,
+        LIGHT_BLOCK_CHANNEL,
+        PARAMS_CHANNEL,
+        SNAPSHOT_CHANNEL,
+    )
+    from .statesync import messages as ssm
+    from .statesync.reactor import StateSyncReactor, SyncConfig
+    from .store.blockstore import BlockStore
+    from .store.db import MemDB
+
+    src_bstore, src_sstore, src_conns, genesis, _keys = await build_kvstore_chain(
+        n_blocks, n_vals
+    )
+
+    def channels() -> dict[int, Channel]:
+        return {
+            cid: Channel(cid, name, 5, ssm.encode_message, ssm.decode_message)
+            for cid, name in (
+                (SNAPSHOT_CHANNEL, "snapshot"),
+                (CHUNK_CHANNEL, "chunk"),
+                (LIGHT_BLOCK_CHANNEL, "lightblock"),
+                (PARAMS_CHANNEL, "params"),
+            )
+        }
+
+    src_ch, dst_ch = channels(), channels()
+
+    server_q: asyncio.Queue = asyncio.Queue()
+    client_q: asyncio.Queue = asyncio.Queue()
+    server = StateSyncReactor(
+        genesis.chain_id, src_conns, src_sstore, src_bstore,
+        src_ch[SNAPSHOT_CHANNEL], src_ch[CHUNK_CHANNEL],
+        src_ch[LIGHT_BLOCK_CHANNEL], src_ch[PARAMS_CHANNEL], server_q,
+    )
+    dst_app = AppConns.local(KVStoreApp(MemDB()))
+    dst_bstore = BlockStore(MemDB())
+    dst_sstore = StateStore(MemDB())
+    client = StateSyncReactor(
+        genesis.chain_id, dst_app, dst_sstore, dst_bstore,
+        dst_ch[SNAPSHOT_CHANNEL], dst_ch[CHUNK_CHANNEL],
+        dst_ch[LIGHT_BLOCK_CHANNEL], dst_ch[PARAMS_CHANNEL], client_q,
+    )
+
+    async def pump(src: Channel, dst: Channel, from_name: str) -> None:
+        while True:
+            env = await src.out_q.get()
+            await dst.in_q.put(Envelope(env.channel_id, env.message, from_=from_name))
+
+    pumps = [
+        asyncio.get_running_loop().create_task(pump(a, b, name))
+        for cid in src_ch
+        for a, b, name in (
+            (dst_ch[cid], src_ch[cid], "client"),
+            (src_ch[cid], dst_ch[cid], "server"),
+        )
+    ]
+    await server.start()
+    await client.start()
+    await client_q.put(PeerUpdate("server", PeerStatus.UP))
+    try:
+        meta1 = src_bstore.load_block_meta(1)
+        cfg = SyncConfig(
+            trust_height=1,
+            trust_hash=meta1.header.hash(),
+            trust_period_ns=10 * 365 * 24 * 3600 * 10**9,
+            backfill_blocks=backfill_blocks,
+        )
+        state = await asyncio.wait_for(client.sync(cfg), timeout=300)
+        assert state.last_block_height >= n_blocks - 12, state.last_block_height
+        held = 0
+        h = state.last_block_height
+        while h >= 1 and dst_bstore.load_block_meta(h) is not None:
+            held += 1
+            h -= 1
+        return held
+    finally:
+        for t in pumps:
+            t.cancel()
+        await client.stop()
+        await server.stop()
+        await dst_app.stop()
+        await src_conns.stop()
 
 
 def make_vote(

@@ -274,26 +274,36 @@ class Header:
     last_results_hash: bytes = b""
     evidence_hash: bytes = b""
     proposer_address: bytes = b""
-    version: int = 1  # block protocol version
+    version: int = 11  # block protocol version (reference version/version.go:27)
 
     def hash(self) -> bytes:
+        """Merkle root over the 14 proto-encoded header fields, byte-exact
+        with the reference (types/block.go headerHash region: each field
+        runs through cdcEncode — a single-field proto wrapper with
+        default-elision — before hashing; Version/Time/LastBlockID are
+        their proto messages). Frozen against reference-produced vectors
+        in tests/test_light_mbt.py and tests/test_golden_vectors.py."""
         if not self.validators_hash:
             return b""
+
+        def cdc(b: bytes) -> bytes:  # gogotypes.BytesValue, empty -> nil
+            return pe.bytes_field(1, b)
+
         fields = [
-            pe.uvarint(self.version),
-            self.chain_id.encode(),
-            pe.uvarint(self.height),
+            pe.varint_field(1, self.version),  # Consensus{block}; app=0 elided
+            pe.string_field(1, self.chain_id),
+            pe.varint_field(1, self.height),
             encode_timestamp(self.time_ns),
             self.last_block_id.encode(),
-            self.last_commit_hash,
-            self.data_hash,
-            self.validators_hash,
-            self.next_validators_hash,
-            self.consensus_hash,
-            self.app_hash,
-            self.last_results_hash,
-            self.evidence_hash,
-            self.proposer_address,
+            cdc(self.last_commit_hash),
+            cdc(self.data_hash),
+            cdc(self.validators_hash),
+            cdc(self.next_validators_hash),
+            cdc(self.consensus_hash),
+            cdc(self.app_hash),
+            cdc(self.last_results_hash),
+            cdc(self.evidence_hash),
+            cdc(self.proposer_address),
         ]
         return merkle.hash_from_byte_slices(fields)
 

@@ -39,16 +39,19 @@ class Validator:
         return Validator(self.pub_key, self.voting_power, self.proposer_priority)
 
     def simple_encode(self) -> bytes:
-        """Encoding used for the validator-set hash: (key type, key bytes,
-        power) — everything a light client needs to check commits."""
-        out = pe.string_field(1, self.pub_key.TYPE)
-        out += pe.bytes_field(2, self.pub_key.bytes())
-        out += pe.varint_field(3, self.voting_power)
+        """SimpleValidator proto encoding used for the validator-set hash
+        (reference types/validator.go Bytes(): SimpleValidator{PubKey,
+        VotingPower}) — byte-exact with the reference; frozen against its
+        MBT vectors in tests/test_light_mbt.py."""
+        from ..crypto import pubkey_to_proto
+
+        out = pe.message_field(1, pubkey_to_proto(self.pub_key))
+        out += pe.varint_field(2, self.voting_power)
         return out
 
     def encode(self) -> bytes:
         out = self.simple_encode()
-        out += pe.sfixed64_field(4, self.proposer_priority)
+        out += pe.sfixed64_field(3, self.proposer_priority)
         return out
 
     @classmethod
@@ -56,20 +59,23 @@ class Validator:
         from .. import crypto
 
         r = pe.Reader(data)
-        ktype, kbytes, power, prio = "", b"", 0, 0
+        pub, power, prio = None, 0, 0
         while not r.eof():
             f, wt = r.read_tag()
             if f == 1:
-                ktype = r.read_bytes().decode()
+                pub = crypto.pubkey_from_proto(r.read_bytes())
             elif f == 2:
-                kbytes = r.read_bytes()
-            elif f == 3:
                 power = r.read_uvarint()
-            elif f == 4:
+            elif f == 3:
                 prio = r.read_sfixed64()
             else:
                 r.skip(wt)
-        return cls(crypto.pubkey_from_type_and_bytes(ktype, kbytes), power, prio)
+        if pub is None:
+            # fail HERE so the router's decode guard converts it into a
+            # peer error, instead of a None pub key detonating later
+            # inside reactor logic
+            raise ValueError("validator encoding missing public key")
+        return cls(pub, power, prio)
 
 
 class ValidatorSet:

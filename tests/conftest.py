@@ -34,11 +34,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # -- minimal async test support (pytest-asyncio is not in the image) --------
 
 import asyncio  # noqa: E402
+import gc  # noqa: E402
 import inspect  # noqa: E402
 
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: run test on a fresh event loop")
+    config.addinivalue_line("markers", "slow: long-running multi-process e2e tests")
 
 
 def pytest_pyfunc_call(pyfuncitem):
@@ -48,12 +50,27 @@ def pytest_pyfunc_call(pyfuncitem):
             name: pyfuncitem.funcargs[name]
             for name in pyfuncitem._fixtureinfo.argnames
         }
-        asyncio.run(func(**kwargs))
+
+        async def _run_with_leak_check():
+            await func(**kwargs)
+            # Leak hygiene (the asyncio analog of the reference's leaktest,
+            # internal/libs/sync/deadlock.go): cancel anything the test
+            # left running and collect garbage WHILE the loop is alive, so
+            # transport finalizers close their sockets on a live loop
+            # instead of raising "Event loop is closed" at interpreter GC.
+            leaked = [
+                t
+                for t in asyncio.all_tasks()
+                if t is not asyncio.current_task() and not t.done()
+            ]
+            for t in leaked:
+                t.cancel()
+            if leaked:
+                await asyncio.gather(*leaked, return_exceptions=True)
+            await asyncio.sleep(0)
+            gc.collect()
+            await asyncio.sleep(0.01)  # let close callbacks run
+
+        asyncio.run(_run_with_leak_check())
         return True
     return None
-
-
-def pytest_configure(config):
-    config.addinivalue_line(
-        "markers", "slow: long-running multi-process e2e tests"
-    )

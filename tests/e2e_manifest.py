@@ -1,0 +1,301 @@
+"""Manifest-driven multi-process e2e runner (reference
+test/e2e/pkg/manifest.go:12-68 + runner/perturb.go — containers replaced
+by OS processes; same black-box method: drive and observe over RPC only).
+
+A Manifest describes the network: per-node mode (validator/full/seed),
+key type, late-start height, statesync bootstrapping, and a perturbation
+sequence (kill / pause / disconnect / restart). The runner generates the
+homes, spawns the processes, applies the perturbations, and asserts
+whole-network app-hash convergence at a common height."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from tendermint_tpu.cli import main as cli_main
+from tendermint_tpu.config import Config, config_from_toml, config_to_toml
+
+MS = 1_000_000
+
+
+@dataclass
+class NodeSpec:
+    """One node (reference manifest.go ManifestNode)."""
+
+    name: str
+    mode: str = "validator"  # validator | full | seed
+    key_type: str = "ed25519"
+    start_at: int = 0  # join once the network reaches this height
+    state_sync: bool = False
+    perturb: tuple[str, ...] = ()  # kill | pause | disconnect | restart
+
+
+@dataclass
+class Manifest:
+    nodes: list[NodeSpec]
+    target_height: int = 4  # height before perturbations begin
+
+
+class Runner:
+    def __init__(self, manifest: Manifest, base_dir: str, base_port: int):
+        self.m = manifest
+        self.base = base_dir
+        self.base_port = base_port
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.rpc_ports: dict[str, int] = {}
+        self.p2p_ports: dict[str, int] = {}
+
+    # -- setup -----------------------------------------------------------
+
+    def setup(self) -> None:
+        validators = [n for n in self.m.nodes if n.mode == "validator"]
+        others = [n for n in self.m.nodes if n.mode != "validator"]
+        rc = cli_main(
+            [
+                "testnet",
+                "--validators", str(len(validators)),
+                "--output", self.base,
+                "--base-port", str(self.base_port),
+                "--key-types", ",".join(v.key_type for v in validators),
+            ]
+        )
+        assert rc == 0
+        genesis_src = os.path.join(self.base, "node0", "config", "genesis.json")
+        genesis = open(genesis_src).read()
+
+        for i, spec in enumerate(validators):
+            self._adopt(spec, os.path.join(self.base, f"node{i}"),
+                        self.base_port + 2 * i)
+        port = self.base_port + 2 * len(validators)
+        for spec in others:
+            home = os.path.join(self.base, spec.name)
+            rc = cli_main(["--home", home, "init", "full"])
+            assert rc == 0
+            with open(os.path.join(home, "config", "genesis.json"), "w") as f:
+                f.write(genesis)
+            self._adopt(spec, home, port)
+            port += 2
+
+        # every node lists every validator as a persistent peer, except
+        # seed-discovery nodes which learn addresses from the seed only
+        seed_specs = [s for s in self.m.nodes if s.mode == "seed"]
+        val_peers = ",".join(
+            self._peer_addr(os.path.join(self.base, f"node{i}"),
+                            self.p2p_ports[s.name])
+            for i, s in enumerate(validators)
+        )
+        for spec in self.m.nodes:
+            home = self._home(spec)
+            cfg_path = os.path.join(home, "config", "config.toml")
+            cfg = config_from_toml(open(cfg_path).read())
+            if spec.mode == "seed":
+                cfg.mode = "seed"
+                cfg.p2p.persistent_peers = val_peers
+            elif spec.state_sync:
+                # statesync nodes learn peers normally but bootstrap state
+                # from a snapshot; trust anchor filled in at start time
+                cfg.p2p.persistent_peers = val_peers
+            elif seed_specs and spec.mode == "full":
+                # full nodes exercise seed discovery: no persistent peers
+                cfg.p2p.persistent_peers = ""
+                cfg.p2p.seeds = ",".join(
+                    self._peer_addr(self._home(s), self.p2p_ports[s.name])
+                    for s in seed_specs
+                )
+            else:
+                cfg.p2p.persistent_peers = val_peers
+            open(cfg_path, "w").write(config_to_toml(cfg))
+
+    def _adopt(self, spec: NodeSpec, home: str, p2p_port: int) -> None:
+        if os.path.basename(home) != spec.name:
+            os.rename(home, self._home(spec))
+        home = self._home(spec)
+        self.p2p_ports[spec.name] = p2p_port
+        self.rpc_ports[spec.name] = p2p_port + 1
+        cfg_path = os.path.join(home, "config", "config.toml")
+        cfg = config_from_toml(open(cfg_path).read())
+        cfg.p2p.laddr = f"127.0.0.1:{p2p_port}"
+        cfg.rpc.laddr = f"127.0.0.1:{p2p_port + 1}"
+        cfg.consensus.timeout_propose_ns = 1000 * MS
+        cfg.consensus.timeout_prevote_ns = 400 * MS
+        cfg.consensus.timeout_precommit_ns = 400 * MS
+        cfg.consensus.timeout_commit_ns = 300 * MS
+        open(cfg_path, "w").write(config_to_toml(cfg))
+
+    def _home(self, spec: NodeSpec) -> str:
+        return os.path.join(self.base, spec.name)
+
+    def _peer_addr(self, home: str, port: int) -> str:
+        nk = json.load(open(os.path.join(home, "config", "node_key.json")))
+        from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+        from tendermint_tpu.p2p.types import node_id_from_pubkey
+
+        key = Ed25519PrivKey(bytes.fromhex(nk["priv_key"])[:32])
+        return f"tcp://{node_id_from_pubkey(key.pub_key())}@127.0.0.1:{port}"
+
+    # -- process control --------------------------------------------------
+
+    def spawn(self, spec: NodeSpec) -> None:
+        env = dict(
+            os.environ,
+            TMTPU_DISABLE_TPU="1",
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        home = self._home(spec)
+        self.procs[spec.name] = subprocess.Popen(
+            [
+                sys.executable,
+                "-c",
+                "from tendermint_tpu.cli import main; import sys; "
+                f"sys.exit(main(['--home', {home!r}, 'start']))",
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            start_new_session=True,
+        )
+
+    def rpc(self, name: str, path: str) -> dict:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{self.rpc_ports[name]}/{path}", timeout=5
+        ) as resp:
+            return json.loads(resp.read())["result"]
+
+    def height(self, name: str) -> int:
+        return int(self.rpc(name, "status")["sync_info"]["latest_block_height"])
+
+    def wait_height(self, name: str, height: int, timeout: float) -> None:
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                last = self.height(name)
+                if last >= height:
+                    return
+            except Exception:
+                pass
+            time.sleep(0.5)
+        raise TimeoutError(f"{name} stuck at {last} (wanted {height})")
+
+    # -- perturbations (reference runner/perturb.go) ----------------------
+
+    def perturb(self, spec: NodeSpec, kind: str, observer: str) -> None:
+        proc = self.procs[spec.name]
+        if kind == "kill":
+            # SIGKILL + restart on the same stores (WAL/handshake recovery)
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+            self.wait_network_progress(observer, 2, 120)
+            self.spawn(spec)
+        elif kind == "restart":
+            # graceful stop + restart
+            os.killpg(proc.pid, signal.SIGTERM)
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+            self.spawn(spec)
+        elif kind == "pause":
+            # SIGSTOP briefly (reference perturb pause): peers keep it
+            os.killpg(proc.pid, signal.SIGSTOP)
+            time.sleep(3)
+            os.killpg(proc.pid, signal.SIGCONT)
+        elif kind == "disconnect":
+            # long freeze: peers time the node out and drop it; on resume
+            # it must re-dial and catch up (the no-container analog of
+            # docker network disconnect)
+            os.killpg(proc.pid, signal.SIGSTOP)
+            self.wait_network_progress(observer, 2, 120)
+            time.sleep(8)
+            os.killpg(proc.pid, signal.SIGCONT)
+        else:
+            raise ValueError(f"unknown perturbation {kind!r}")
+
+    def wait_network_progress(self, observer: str, blocks: int, timeout: float):
+        h = self.height(observer)
+        self.wait_height(observer, h + blocks, timeout)
+
+    # -- the run ----------------------------------------------------------
+
+    def run(self) -> None:
+        starters = [n for n in self.m.nodes if n.start_at == 0]
+        late = [n for n in self.m.nodes if n.start_at > 0]
+        for spec in starters:
+            self.spawn(spec)
+        observer = next(n.name for n in self.m.nodes if n.mode == "validator")
+        for spec in starters:
+            if spec.mode != "seed":
+                self.wait_height(spec.name, self.m.target_height, 180)
+
+        for spec in late:
+            self.wait_height(observer, spec.start_at, 180)
+            if spec.state_sync:
+                trust_h = max(1, self.height(observer) - 8)
+                trust_hash = self.rpc(
+                    observer, f"block?height={trust_h}"
+                )["block_id"]["hash"]
+                home = self._home(spec)
+                cfg_path = os.path.join(home, "config", "config.toml")
+                cfg = config_from_toml(open(cfg_path).read())
+                cfg.statesync.enable = True
+                cfg.statesync.trust_height = trust_h
+                cfg.statesync.trust_hash = trust_hash
+                open(cfg_path, "w").write(config_to_toml(cfg))
+            self.spawn(spec)
+            self.wait_height(spec.name, self.height(observer), 180)
+
+        for spec in self.m.nodes:
+            for kind in spec.perturb:
+                self.perturb(spec, kind, observer)
+                # every perturbation must heal: the node returns to the
+                # network tip (reference perturb.go waits for progress)
+                self.wait_network_progress(observer, 2, 120)
+                self.wait_height(spec.name, self.height(observer), 180)
+
+        self.assert_convergence()
+
+    def assert_convergence(self) -> None:
+        non_seed = [n for n in self.m.nodes if n.mode != "seed"]
+        common = min(self.height(n.name) for n in non_seed)
+        # statesync nodes have no blocks below their snapshot; pick a
+        # height everyone serves
+        floor = max(
+            int(self.rpc(n.name, "status")["sync_info"].get(
+                "earliest_block_height", 1
+            ))
+            for n in non_seed
+        )
+        check = max(common, floor)
+        hashes = {
+            self.rpc(n.name, f"block?height={check}")["block"]["header"][
+                "app_hash"
+            ]
+            for n in non_seed
+        }
+        assert len(hashes) == 1, f"app hash divergence at {check}: {hashes}"
+
+    def teardown(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                try:
+                    os.killpg(p.pid, signal.SIGCONT)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    p.kill()
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass

@@ -1,0 +1,59 @@
+"""Manifest-driven e2e matrix (reference test/e2e/pkg/manifest.go +
+runner/perturb.go): perturbations, a statesync-joining node, a seed node
+with seed-discovered full node, and a mixed-key validator set — each run
+ends in whole-network app-hash convergence."""
+
+import pytest
+
+from tests.e2e_manifest import Manifest, NodeSpec, Runner
+
+
+def _run(manifest: Manifest, tmp_path, base_port: int) -> None:
+    r = Runner(manifest, str(tmp_path / "net"), base_port)
+    try:
+        r.setup()
+        r.run()
+    finally:
+        r.teardown()
+
+
+@pytest.mark.slow
+def test_perturbation_matrix(tmp_path):
+    """4 validators (one secp256k1 — mixed-key set): pause one, kill +
+    restart another, freeze-disconnect a third; every wound heals to
+    app-hash convergence."""
+    _run(
+        Manifest(
+            nodes=[
+                NodeSpec("node0", perturb=("pause",)),
+                NodeSpec("node1", key_type="secp256k1", perturb=("kill",)),
+                NodeSpec("node2", perturb=("disconnect",)),
+                NodeSpec("node3", perturb=("restart",)),
+            ],
+            target_height=3,
+        ),
+        tmp_path,
+        28700,
+    )
+
+
+@pytest.mark.slow
+def test_statesync_joiner_and_seed_discovery(tmp_path):
+    """A seed node plus a full node that discovers the network ONLY
+    through the seed, and a statesync node that joins late from a
+    snapshot (kvstore snapshots every 10 blocks)."""
+    _run(
+        Manifest(
+            nodes=[
+                NodeSpec("node0"),
+                NodeSpec("node1"),
+                NodeSpec("node2"),
+                NodeSpec("seed0", mode="seed"),
+                NodeSpec("full0", mode="full"),
+                NodeSpec("sync0", mode="full", state_sync=True, start_at=12),
+            ],
+            target_height=3,
+        ),
+        tmp_path,
+        28760,
+    )

@@ -1,0 +1,61 @@
+"""Event sink differential tests: the kv sink (reference indexer/sink/kv)
+and the relational sink (reference indexer/sink/psql, DB-API port) must
+answer identically for the same indexed history."""
+
+import pytest
+
+from tendermint_tpu.libs.pubsub import Query
+from tendermint_tpu.state.indexer import KVSink, TxResult
+from tendermint_tpu.state.sql_sink import SQLEventSink
+from tendermint_tpu.store.db import MemDB
+
+
+def _sinks():
+    return [KVSink(MemDB()), SQLEventSink.sqlite(":memory:", chain_id="t")]
+
+
+def _populate(sink):
+    sink.index_tx(
+        TxResult(1, 0, b"alpha=1", 0, b"", "", {"kv.key": ["alpha"]})
+    )
+    sink.index_tx(
+        TxResult(2, 0, b"beta=2", 0, b"", "", {"kv.key": ["beta"]})
+    )
+    sink.index_tx(
+        TxResult(2, 1, b"alpha=3", 0, b"", "", {"kv.key": ["alpha"]})
+    )
+    sink.index_block(1, {"block.proposer": ["aa"]})
+    sink.index_block(2, {"block.proposer": ["bb"]})
+
+
+@pytest.mark.parametrize("sink", _sinks(), ids=["kv", "sql"])
+def test_get_tx_roundtrip(sink):
+    _populate(sink)
+    res = TxResult(1, 0, b"alpha=1", 0, b"", "", {"kv.key": ["alpha"]})
+    got = sink.get_tx(res.hash)
+    assert got is not None and got.tx == b"alpha=1" and got.height == 1
+
+
+@pytest.mark.parametrize("sink", _sinks(), ids=["kv", "sql"])
+def test_search_by_event_attribute(sink):
+    _populate(sink)
+    out = sink.search_txs(Query.parse("kv.key = 'alpha'"))
+    assert [(r.height, r.index) for r in out] == [(1, 0), (2, 1)]
+
+
+@pytest.mark.parametrize("sink", _sinks(), ids=["kv", "sql"])
+def test_search_by_height(sink):
+    _populate(sink)
+    out = sink.search_txs(Query.parse("tx.height = 2"))
+    assert [(r.height, r.index) for r in out] == [(2, 0), (2, 1)]
+
+
+@pytest.mark.parametrize("sink", _sinks(), ids=["kv", "sql"])
+def test_search_blocks(sink):
+    _populate(sink)
+    assert sink.search_blocks(Query.parse("block.proposer = 'bb'")) == [2]
+
+
+def test_postgres_constructor_gated():
+    with pytest.raises(RuntimeError, match="psycopg2"):
+        SQLEventSink.postgres("dbname=x")

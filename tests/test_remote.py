@@ -157,3 +157,116 @@ class TestRemoteSigner:
         finally:
             await node.stop()
             server.stop()
+
+
+class TestABCIGrpc:
+    """gRPC attachment mode (reference abci/client/grpc_client.go,
+    abci/server/grpc_server.go) — same method table and codec as the
+    socket transport."""
+
+    @pytest.mark.asyncio
+    async def test_full_roundtrip(self):
+        from tendermint_tpu.abci.grpcnet import GrpcABCIServer, GrpcClient
+
+        app = KVStoreApp()
+        server = GrpcABCIServer(app)
+        await server.start()
+        client = GrpcClient("127.0.0.1", server.port)
+        await client.start()
+        try:
+            assert await client.echo("hi") == "hi"
+            info = await client.info(abci.RequestInfo())
+            assert info.last_block_height == 0
+            await client.init_chain(
+                abci.RequestInitChain(0, "c", None, (), b"{}", 1)
+            )
+            from tendermint_tpu.types.block import Header
+
+            await client.begin_block(
+                abci.RequestBeginBlock(
+                    hash=b"\x01" * 32,
+                    header=Header(chain_id="c", height=1),
+                    last_commit_info=abci.LastCommitInfo(0),
+                )
+            )
+            dres = await client.deliver_tx(abci.RequestDeliverTx(b"g=rpc"))
+            assert dres.is_ok()
+            await client.end_block(abci.RequestEndBlock(1))
+            cres = await client.commit()
+            assert cres.data
+            q = await client.query(abci.RequestQuery(data=b"g"))
+            assert q.value == b"rpc"
+        finally:
+            await client.stop()
+            await server.stop()
+
+    @pytest.mark.asyncio
+    async def test_node_runs_against_grpc_app(self):
+        """Full consensus through the gRPC app connection."""
+        from tendermint_tpu.abci.grpcnet import GrpcABCIServer, GrpcClient
+        from tendermint_tpu.consensus.harness import Node as HNode, make_genesis
+        from tendermint_tpu.proxy import AppConns
+
+        app = KVStoreApp()
+        server = GrpcABCIServer(app)
+        await server.start()
+        genesis, keys = make_genesis(1)
+        node = HNode(genesis, keys[0])
+
+        def factory(name: str):
+            return GrpcClient("127.0.0.1", server.port)
+
+        node.app_conns = AppConns.from_factory(factory)
+        await node.app_conns.start()
+        await node.start()
+        try:
+            await node.cs.wait_for_height(2, timeout=30)
+            assert app.height >= 2
+        finally:
+            await node.stop()
+            await server.stop()
+
+
+class TestGrpcSigner:
+    @pytest.mark.asyncio
+    async def test_sign_via_grpc(self):
+        """privval gRPC mode (reference privval/grpc/{server,client}.go):
+        pubkey fetch, vote signing, double-sign guard over the channel."""
+        from tendermint_tpu.privval_remote import GrpcSignerClient, GrpcSignerServer
+
+        with tempfile.TemporaryDirectory() as tmp:
+            pv = FilePV.generate(
+                os.path.join(tmp, "k.json"), os.path.join(tmp, "s.json")
+            )
+            server = GrpcSignerServer(pv)
+            port = server.start()
+            client = GrpcSignerClient("127.0.0.1", port)
+
+            def sync_part():
+                pub = client.get_pub_key()
+                assert pub.bytes() == pv.get_pub_key().bytes()
+                vote = Vote(
+                    type=SignedMsgType.PREVOTE,
+                    height=3,
+                    round=0,
+                    block_id=make_block_id(b"x"),
+                    timestamp_ns=1_700_000_000_000_000_000,
+                    validator_address=pub.address(),
+                    validator_index=0,
+                )
+                signed = client.sign_vote("chain", vote)
+                assert pub.verify_signature(
+                    vote.sign_bytes("chain"), signed.signature
+                )
+                conflicting = Vote(
+                    **{**vote.__dict__, "block_id": make_block_id(b"y")}
+                )
+                try:
+                    client.sign_vote("chain", conflicting)
+                    assert False, "expected DoubleSignError"
+                except DoubleSignError:
+                    pass
+                client.close()
+
+            await asyncio.to_thread(sync_part)
+            server.stop()

@@ -14,10 +14,11 @@ from tests.test_node import NodeNet
 LONG_NS = 10 * 365 * 24 * 3600 * 10**9
 
 
-async def rpc_net(n=2):
+async def rpc_net(n=2, pprof=False):
     net = NodeNet(n)
     for node in net.nodes:
         node.config.rpc_laddr = "127.0.0.1:0"
+        node.config.rpc_pprof = pprof
     await net.start()
     await net.wait_for_height(2, timeout=60)
     clients = [
@@ -47,6 +48,52 @@ class TestRPC:
                 async with s.get(c.base_url + "/health") as resp:
                     body = await resp.json()
                     assert body["result"] == {}
+        finally:
+            for cl in clients:
+                await cl.close()
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_pprof_endpoints(self):
+        """Live profiling routes (reference pprof-laddr analog): CPU
+        profile over a window, heap snapshot arm+report+disarm, stack
+        dump; off by default; NaN windows rejected."""
+        net, clients = await rpc_net(pprof=True)
+        c = clients[0]
+        try:
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    c.base_url + "/debug/pprof/profile?seconds=0.3"
+                ) as resp:
+                    body = await resp.text()
+                    assert resp.status == 200 and "cumulative" in body
+                # non-finite windows must be rejected, not park the
+                # profiler forever
+                async with s.get(
+                    c.base_url + "/debug/pprof/profile?seconds=nan"
+                ) as resp:
+                    assert resp.status == 400
+                async with s.get(c.base_url + "/debug/pprof/heap") as resp:
+                    assert "tracemalloc armed" in await resp.text()
+                async with s.get(c.base_url + "/debug/pprof/heap") as resp:
+                    assert "heap snapshot" in await resp.text()
+                async with s.get(
+                    c.base_url + "/debug/pprof/heap?op=stop"
+                ) as resp:
+                    assert "disarmed" in await resp.text()
+                async with s.get(c.base_url + "/debug/pprof/stacks") as resp:
+                    assert "Thread" in await resp.text()
+
+            # and OFF by default: a default-constructed server has no
+            # pprof routes (the reference only serves pprof when
+            # pprof-laddr is explicitly configured)
+            from tendermint_tpu.rpc.server import RPCServer
+
+            default_server = RPCServer(net.nodes[0].rpc_server.env)
+            routes = {r.resource.canonical for r in default_server.app.router.routes()}
+            assert "/debug/pprof/profile" not in routes
         finally:
             for cl in clients:
                 await cl.close()
