@@ -151,46 +151,63 @@ def kernel_breakdown(items: list) -> dict:
     # time budget on compile
     entries = [tpuv.resolve_ed25519(*it) for it in items[:1024]]
     b = tpuv._bucket(len(entries))
-    a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid = tpuv.prepare_batch_eq(
-        entries, pad_to=b
+    ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx = (
+        tpuv.prepare_batch_eq(entries, pad_to=b)
     )
+    gb = ua_bytes.shape[0]
 
     def timeit(fn, *args, reps=5):
         out = fn(*args)
-        jax.block_until_ready(out)  # compile + warm
+        out = np.asarray(jax.tree.leaves(out)[0])  # compile + warm + sync
         t0 = time.perf_counter()
         for _ in range(reps):
             out = fn(*args)
-        jax.block_until_ready(out)
+        np.asarray(jax.tree.leaves(out)[0])  # force execution (axon defers)
         return (time.perf_counter() - t0) / reps
 
     dec = jax.jit(
         lambda ab, rb: curve.decompress(jnp.concatenate([ab, rb], axis=0))
     )
-    t_dec = timeit(dec, a_bytes, r_bytes)
-    stacked, _ok = dec(a_bytes, r_bytes)
-    pts = Point(*(jnp.asarray(c[:b]) for c in stacked))
+    t_dec = timeit(dec, ua_bytes, r_bytes)
+    stacked, _ok = dec(ua_bytes, r_bytes)
+    # A-side timed at gb+1 rows exactly as _kernel_eq runs it (the +1
+    # base-point row keeps the length a power of two -> blocked-prefix
+    # path; gb alone would fall back to the associative_scan branch and
+    # time a different algorithm)
+    bpt = curve.base_point(())
+    a_pts = Point(
+        *(
+            jnp.concatenate([jnp.asarray(c[:gb]), bc[None]], axis=0)
+            for c, bc in zip(stacked, bpt)
+        )
+    )
+    r_pts = Point(*(jnp.asarray(c[gb : gb + b]) for c in stacked))
+    ga_full = jnp.concatenate([jnp.asarray(ga_digits), jnp.asarray(zs_digits)], axis=1)
 
     msm_fn = jax.jit(msm.msm)
-    t_msm_a = timeit(msm_fn, pts, jnp.asarray(a_digits[:, :b]))  # 32 windows
-    t_msm_r = timeit(msm_fn, pts, jnp.asarray(r_digits[:, :b]))  # 16 windows
+    t_msm_a = timeit(msm_fn, a_pts, ga_full)  # 32 windows, grouped + base row
+    t_msm_r = timeit(msm_fn, r_pts, jnp.asarray(r_digits))  # 16 windows
     t_full = timeit(
         jax.jit(tpuv._kernel_eq),
-        a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid,
+        ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx,
     )
 
     # arithmetic accounting: point_add ≈ 9 field muls, double ≈ 8.
-    # Per window: sort + associative_scan (~2M adds) + 256-leaf collapse
-    # (~264 adds) + 255× multiply (7 dbl + 7 add). 48 windows total
-    # (32 A-group + 16 R-group); Horner fold adds 8 dbl + 1 add per window.
-    n_windows = 48
-    adds_per_window = 2 * b + 264 + 14
-    fmuls = n_windows * (adds_per_window * 9 + 8 * 8 + 9)
+    # Per window: sort + blocked boundary prefixes (~M + 2M/16 + 256
+    # adds) + 256-leaf collapse (~264 adds) + 255× multiply (7 dbl +
+    # 7 add). 16 R-group windows at M=b, 32 A-group windows at M=gb+1;
+    # Horner fold adds 8 dbl + 1 add per window.
+    def window_adds(m):
+        return m + 2 * m // 16 + 256 + 264 + 14
+
+    adds = 16 * window_adds(b) + 32 * window_adds(gb + 1)
+    fmuls = adds * 9 + 48 * (8 * 8 + 9)
     # one field mul (GEMM path) routes 32*32*32 ≈ 32.8k f32 MACs through
     # the MXU per element-pair after batching
     flops = fmuls * 2 * 32 * 32 * 32
     bd = {
         "batch": b,
+        "groups": gb,
         "decompress_ms": round(t_dec * 1e3, 2),
         "msm_a32_ms": round(t_msm_a * 1e3, 2),
         "msm_r16_ms": round(t_msm_r * 1e3, 2),

@@ -82,23 +82,87 @@ def _mul_255(p: Point) -> Point:
     return r
 
 
+_BLOCK = 16  # sequential within-block scan length (see _boundary_prefixes)
+
+
+def _boundary_prefixes(sorted_pts: Point, counts: jnp.ndarray) -> Point:
+    """C_j = prefix sum of the first counts[j] sorted points (identity
+    when counts[j] == 0), for the 256 bucket boundaries.
+
+    Only those 256 prefixes are ever consumed, so materializing all M
+    global prefixes (associative_scan: ~2M point-adds) is wasteful. The
+    blocked scheme does ~M + 2·M/_BLOCK + 256 adds:
+
+      reshape to (G, _BLOCK) blocks · sequential lax.scan of length
+      _BLOCK for within-block inclusive prefixes (M adds, each step a
+      G-wide batched add — full VPU occupancy for G = M/16) · exclusive
+      associative_scan over the G block totals (~2G adds) · ONE add per
+      boundary combining block offset + within-block prefix (256 adds).
+
+    Falls back to the associative_scan formulation when the batch
+    doesn't divide by _BLOCK (e.g. the 8-device sharded kernel's small
+    per-shard remainders keep shapes divisible anyway)."""
+    m = sorted_pts.x.shape[0]
+    ident = curve.identity((1,))
+    if m % _BLOCK or m // _BLOCK < 2:
+        prefix = jax.lax.associative_scan(curve.point_add, sorted_pts, axis=0)
+        padded = Point(
+            *(jnp.concatenate([i_c, c], axis=0) for i_c, c in zip(ident, prefix))
+        )
+        return Point(*(jnp.take(c, counts, axis=0) for c in padded))
+
+    g = m // _BLOCK
+    blocks = Point(*(c.reshape(g, _BLOCK, -1) for c in sorted_pts))
+
+    # within-block inclusive prefix: scan over the _BLOCK axis, carrying
+    # the running sum per block ((g, 32)-shaped adds)
+    first = Point(*(c[:, 0] for c in blocks))
+    rest = Point(*(jnp.moveaxis(c[:, 1:], 1, 0) for c in blocks))  # (B-1, g, 32)
+
+    def step(acc: Point, nxt: Point):
+        acc = curve.point_add(acc, nxt)
+        return acc, acc
+
+    last, tail = jax.lax.scan(step, first, rest)
+    within = Point(
+        *(
+            jnp.concatenate([f[:, None], jnp.moveaxis(t, 0, 1)], axis=1).reshape(
+                m, -1
+            )
+            for f, t in zip(first, tail)
+        )
+    )  # (M, 32) within-block inclusive prefixes; `last` = block totals
+
+    # exclusive block offsets: shift the inclusive totals scan right
+    totals_prefix = jax.lax.associative_scan(curve.point_add, last, axis=0)
+    offsets = Point(
+        *(
+            jnp.concatenate([i_c, c[:-1]], axis=0)
+            for i_c, c in zip(ident, totals_prefix)
+        )
+    )  # (g, 32): sum of all blocks before this one
+
+    # boundary p = counts[j]-1: C_j = offsets[p // _BLOCK] + within[p]
+    p = jnp.maximum(counts - 1, 0)
+    w_sel = Point(*(jnp.take(c, p, axis=0) for c in within))
+    o_sel = Point(*(jnp.take(c, p // _BLOCK, axis=0) for c in offsets))
+    c_pts = curve.point_add(o_sel, w_sel)
+    empty = counts == 0
+    return curve.point_select(
+        ~empty, c_pts, curve.identity((counts.shape[0],))
+    )
+
+
 def _window_sum(points: Point, digits: jnp.ndarray) -> Point:
     """Σ_j j·B_j for one window. points: coords (M, 32); digits: (M,)."""
     order = jnp.argsort(digits)
     sorted_digits = jnp.take(digits, order)
     sorted_pts = Point(*(jnp.take(c, order, axis=0) for c in points))
 
-    # inclusive prefix sums of point additions over the sorted batch
-    prefix = jax.lax.associative_scan(curve.point_add, sorted_pts, axis=0)
-
     # C_j = prefix at the last position with digit ≤ j (identity if none):
-    # counts c_j = #digits ≤ j, gather from [identity ‖ prefix] at c_j
+    # counts c_j = #digits ≤ j
     counts = jnp.searchsorted(sorted_digits, jnp.arange(N_BUCKETS), side="right")
-    ident = curve.identity((1,))
-    padded = Point(
-        *(jnp.concatenate([i_c, c], axis=0) for i_c, c in zip(ident, prefix))
-    )
-    C = Point(*(jnp.take(c, counts, axis=0) for c in padded))  # (256, 32)
+    C = _boundary_prefixes(sorted_pts, counts)  # (256, 32)
 
     c255 = Point(*(c[N_BUCKETS - 1] for c in C))
     # Σ_{k=0..254} C_k: overwrite slot 255 with identity, tree-reduce all 256

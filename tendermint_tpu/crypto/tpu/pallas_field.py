@@ -5,24 +5,31 @@ through an MXU GEMM against a constant 0/1 anti-diagonal matrix — exact
 and compile-friendly, but 63× MAC-inflated (the matmul's contraction does
 routing, not math) and memory-bound (the (B,1024) outer product round-
 trips HBM per multiply). This kernel computes the convolution directly on
-the VPU with everything resident in VMEM:
+the VPU with everything resident in VMEM.
 
-  packed layout: four field elements per row — (M,32) int32 limbs
-  reshape (free, contiguous) to (M/4,128), filling all 128 lanes
-  conv:   for j in 0..31 (static unroll):
-            acc[:, seg*64+j : +32] += a_scalar[seg,j] * b[:, seg*32 : +32]
-          per-element scalars broadcast via a (M/4,4,32) view
+Layout: TRANSPOSED — limbs along the sublane axis, batch along the lane
+axis. An operand block is (32, T) int32: limb i of lane-batch element n
+at [i, n]. That layout makes every step a full-lane vector op with only
+static sublane slices/concats (Mosaic TC lowers neither scatter-add nor
+lane-dimension reshapes, which sank the two earlier formulations):
+
+  conv:   acc (64, T) f32; for j in 0..31 (static unroll):
+            acc[j : j+32] += a * b[j]      (broadcast of one sublane row,
+                                            shift-by-j as a zero-pad)
   fold:   2^256 ≡ 38, then the same four exact int32 carry passes as
-          field.py (same bounds analysis — limbs < 2^9 in, ≤ 293 out)
+          field.py (same bounds analysis — limbs < 2^9 in, ≤ 293 out);
+          carries move one SUBLANE, i.e. a static concat, per pass.
 
-Cost per element: 64 VPU MAC ops on full 128-lane vectors + ~15 carry
-ops, vs the GEMM path's 64.5k MXU MACs + materialized intermediates.
-f32 is used for the products (exact: ≤ 511² · 32 < 2^24), int32 for the
-carries.
+Cost per element: 32 f32 MAC + ~6 carry vector-ops per limb-vector, all
+VMEM-resident, vs the GEMM path's 64.5k routed MXU MACs + a materialized
+(B,1024) intermediate. f32 products are exact (≤ 511² · 32 < 2^24).
 
-Enabled on TPU backends (field.mul dispatches here); the GEMM path
-remains for CPU and as the differential-testing oracle. Tests run this
-kernel in Pallas interpret mode on CPU.
+The host-side wrapper transposes (…, 32) limbs-last operands to the
+kernel layout and back; XLA fuses those transposes into neighbours where
+it can. Enabled on TPU backends when the A/B probe (verify.py) measures
+it faster than the GEMM; the GEMM path remains for CPU and as the
+differential-testing oracle. Tests run this kernel in Pallas interpret
+mode on CPU.
 """
 
 from __future__ import annotations
@@ -33,90 +40,67 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-PACK = 4  # field elements per 128-lane row
 LIMBS = 32
-SEG = 64  # scratch lanes per element (63 coeffs + pad)
-TILE = 256  # packed rows per grid step (= TILE*PACK elements)
+SEG = 64  # conv scratch sublanes (63 coefficients + 1 structural zero)
+TILE = 512  # lanes (batch elements) per grid step
 
 
 def _mul_kernel(a_ref, b_ref, o_ref):
-    from jax.experimental import pallas as pl  # noqa: F401  (imported for clarity)
-
-    rows = a_ref.shape[0]
-    a = a_ref[:]  # (rows, 128) int32 — 4 elements' limbs per row
-    b = b_ref[:]
-    af = a.astype(jnp.float32)
-    bf = b.astype(jnp.float32)
-    # per-element scalar view: (rows, PACK, LIMBS)
-    a3 = af.reshape(rows, PACK, LIMBS)
-
-    acc = jnp.zeros((rows, PACK * SEG), jnp.float32)
+    a = a_ref[:].astype(jnp.float32)  # (32, T)
+    b = b_ref[:].astype(jnp.float32)  # (32, T)
+    t = a.shape[1]
+    acc = jnp.zeros((SEG, t), jnp.float32)
     for j in range(LIMBS):
-        # scalar a[elem][j] broadcast across the element's 32 lanes
-        scal = jnp.repeat(a3[:, :, j], LIMBS, axis=1)  # (rows, 128)
-        prod = scal * bf  # (rows, 128): element-wise, 4 convs at once
-        for s in range(PACK):
-            sl = slice(s * SEG + j, s * SEG + j + LIMBS)
-            acc = acc.at[:, sl].add(prod[:, s * LIMBS : (s + 1) * LIMBS])
+        prod = a * b[j : j + 1, :]  # (32, T), one sublane row broadcast
+        acc = acc + jnp.pad(prod, ((j, SEG - LIMBS - j), (0, 0)))
 
-    conv = acc.astype(jnp.int32).reshape(rows, PACK, SEG)
-    lo = conv[:, :, :LIMBS]
-    hi = conv[:, :, LIMBS:]
+    conv = acc.astype(jnp.int32)  # exact: every partial sum < 2^24
+    lo = conv[:LIMBS]
+    hi = conv[LIMBS:]
     # 2^256 ≡ 38: coefficient k+32 (= hi[k], k in 0..30) folds onto limb
-    # k with weight 38; coeff 63 is structural zero padding
-    c = lo + 38 * jnp.concatenate(
-        [hi[:, :, :31], jnp.zeros_like(hi[:, :, :1])], axis=2
-    )
+    # k with weight 38; coefficient 63 is structural zero padding
+    c = lo + 38 * jnp.concatenate([hi[:31], jnp.zeros_like(hi[:1])], axis=0)
     for _ in range(4):
         low = c & 0xFF
         carry = c >> 8
-        wrapped = jnp.concatenate(
-            [carry[:, :, 31:] * 38, carry[:, :, :31]], axis=2
-        )
-        c = low + wrapped
-    o_ref[:] = c.reshape(rows, PACK * LIMBS)
+        c = low + jnp.concatenate([carry[31:] * 38, carry[:31]], axis=0)
+    o_ref[:] = c
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def _mul_packed(a2: jnp.ndarray, b2: jnp.ndarray, interpret: bool = False):
+def _mul_limbs_first(a_t: jnp.ndarray, b_t: jnp.ndarray, interpret: bool = False):
+    """(32, M) × (32, M) → (32, M), M a multiple of TILE."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    rows = a2.shape[0]
-    grid = (rows // TILE,) if rows % TILE == 0 and rows >= TILE else (1,)
-    tile = TILE if grid[0] > 1 or rows == TILE else rows
+    m = a_t.shape[1]
     return pl.pallas_call(
         _mul_kernel,
-        out_shape=jax.ShapeDtypeStruct((rows, PACK * LIMBS), jnp.int32),
-        grid=grid,
+        out_shape=jax.ShapeDtypeStruct((LIMBS, m), jnp.int32),
+        grid=(m // TILE,),
         in_specs=[
-            pl.BlockSpec((tile, PACK * LIMBS), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((tile, PACK * LIMBS), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((LIMBS, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((LIMBS, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec(
-            (tile, PACK * LIMBS), lambda i: (i, 0), memory_space=pltpu.VMEM
+            (LIMBS, TILE), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
         interpret=interpret,
-    )(a2, b2)
+    )(a_t, b_t)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
     """Drop-in for field.mul: (..., 32) int32 limbs < 2^9 → (..., 32)
-    limbs ≤ 293. Batch is flattened, padded to a PACK·row multiple,
-    packed 4-per-row, multiplied in VMEM, and unpacked."""
+    limbs ≤ 293. Batch is flattened, padded to a TILE multiple, transposed
+    to the kernel's limbs-first layout, multiplied in VMEM, and restored."""
     a, b = jnp.broadcast_arrays(a, b)
     shape = a.shape
     m = int(np.prod(shape[:-1])) if shape[:-1] else 1
     a2 = a.reshape(m, LIMBS)
     b2 = b.reshape(m, LIMBS)
-    rows = -(-m // PACK)  # ceil
-    pad_elems = rows * PACK - m
-    if pad_elems:
-        a2 = jnp.pad(a2, ((0, pad_elems), (0, 0)))
-        b2 = jnp.pad(b2, ((0, pad_elems), (0, 0)))
-    out = _mul_packed(
-        a2.reshape(rows, PACK * LIMBS), b2.reshape(rows, PACK * LIMBS),
-        interpret=interpret,
-    )
-    out = out.reshape(rows * PACK, LIMBS)[:m]
-    return out.reshape(shape)
+    mp = -(-m // TILE) * TILE
+    if mp != m:
+        a2 = jnp.pad(a2, ((0, mp - m), (0, 0)))
+        b2 = jnp.pad(b2, ((0, mp - m), (0, 0)))
+    out = _mul_limbs_first(a2.T, b2.T, interpret=interpret)
+    return out.T[:m].reshape(shape)

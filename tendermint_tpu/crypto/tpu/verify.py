@@ -67,22 +67,32 @@ def _kernel(a_bytes, r_bytes, s_digits, h_digits, s_valid):
     return a_ok & r_ok & eq_ok & s_valid
 
 
-def _kernel_eq(a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid):
+def _kernel_eq(ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx):
     """Randomized linear-combination batch verification (the reference's
     actual batch algorithm, crypto/ed25519/ed25519.go:225 via
     curve25519-voi): ONE multi-scalar multiplication
 
-        [8]( zs·B − Σ aᵢ·Aᵢ − Σ zᵢ·Rᵢ ) == O
+        [8]( zs·B − Σ_g c_g·A_g − Σ zᵢ·Rᵢ ) == O
 
-    with zs = Σ zᵢ·sᵢ mod L, aᵢ = zᵢ·kᵢ mod L, and zᵢ random 128-bit
-    coefficients sampled per call on the host. Scalars on A and R may be
-    reduced mod L even though those points can carry torsion (ZIP-215):
-    the final ×8 kills every torsion component, so only the prime-order
-    part — where mod-L reduction is exact — survives.
+    with zs = Σ zᵢ·sᵢ mod L and zᵢ random 128-bit coefficients sampled
+    per call on the host. Scalars on A and R may be reduced mod L even
+    though those points can carry torsion (ZIP-215): the final ×8 kills
+    every torsion component, so only the prime-order part — where mod-L
+    reduction is exact — survives.
 
-    Inputs: a_bytes/r_bytes (N,32) int32 compressed points;
-    a_digits (32,N), r_digits (16,N), zs_digits (32,1) int32 radix-256
-    little-endian scalar digits; s_valid (N,) bool (s < L, well-formed).
+    A-side GROUPING: consensus batches repeat public keys (150 validators
+    sign every one of dozens of block-sync commits), and the equation is
+    linear in the points — so the host collapses Σᵢ zᵢkᵢ·Aᵢ to
+    Σ_g c_g·A_g with c_g = Σ_{i: Aᵢ=A_g} zᵢkᵢ mod L over the G unique
+    keys. The 32-window A-side MSM then runs over G+1 rows instead of N
+    (54 commits × 150 validators: 8100 → 151), and only G unique keys are
+    decompressed. Worst case (all keys distinct) degrades to exactly the
+    ungrouped shape.
+
+    Inputs: ua_bytes (G,32) unique compressed keys; r_bytes (N,32);
+    ga_digits (32,G) radix-256 digits of c_g; r_digits (16,N) digits of
+    zᵢ; zs_digits (32,1); s_valid (N,) bool (s < L, well-formed);
+    gidx (N,) int32 mapping each signature to its key group.
     Format-invalid entries arrive with zeroed digits; decompression
     failures are masked to the identity in-kernel, so neither perturbs
     the sum. Returns (ok_bitmap (N,), eq_ok ()): on eq_ok the bitmap IS
@@ -95,22 +105,25 @@ def _kernel_eq(a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid):
     from . import curve, msm
     from .curve import Point
 
-    stacked, ok = curve.decompress(jnp.concatenate([a_bytes, r_bytes], axis=0))
-    n = a_bytes.shape[0]
-    A = Point(*(c[:n] for c in stacked))
-    R = Point(*(c[n:] for c in stacked))
-    ok_bitmap = ok[:n] & ok[n:] & s_valid
+    g = ua_bytes.shape[0]
+    stacked, ok = curve.decompress(jnp.concatenate([ua_bytes, r_bytes], axis=0))
+    A = Point(*(c[:g] for c in stacked))
+    R = Point(*(c[g:] for c in stacked))
+    a_ok, r_ok = ok[:g], ok[g:]
+    r_use = r_ok & s_valid
+    ok_bitmap = jnp.take(a_ok, gidx) & r_use
 
-    ident = curve.identity((n,))
-    Am = curve.point_select(ok_bitmap, curve.point_neg(A), ident)
-    Rm = curve.point_select(ok_bitmap, curve.point_neg(R), ident)
+    Am = curve.point_select(a_ok, curve.point_neg(A), curve.identity((g,)))
+    Rm = curve.point_select(
+        r_use, curve.point_neg(R), curve.identity((r_bytes.shape[0],))
+    )
 
     # A-group MSM carries the base point as one extra row (scalar zs)
     bpt = curve.base_point(())
     ga = Point(
         *(jnp.concatenate([c, b[None]], axis=0) for c, b in zip(Am, bpt))
     )
-    ga_digits = jnp.concatenate([a_digits, zs_digits], axis=1)
+    ga_digits = jnp.concatenate([ga_digits, zs_digits], axis=1)
 
     acc = curve.point_add(msm.msm(ga, ga_digits), msm.msm(Rm, r_digits))
     eq_ok = curve.is_identity(curve.mul_by_cofactor(acc))
@@ -181,19 +194,35 @@ def _maybe_enable_pallas() -> None:
         ):
             raise RuntimeError("pallas field mul mismatch")
 
-        # time both at a realistic MSM batch width (8192 field elements)
-        big = np.random.default_rng(0).integers(0, 256, (8192, 32)).astype(np.int32)
-        gemm_mul = jax.jit(F._mul_gemm)
-        pall_mul = jax.jit(pallas_field.mul)
+        # time both at a realistic MSM batch width (8192 field elements).
+        # Marginal cost of a CHAINED multiply with device-resident inputs
+        # and a forced host readback: a single-call timing would measure
+        # the host->device transfer and the dispatch round-trip (the axon
+        # tunnel defers execution past block_until_ready), not the mul.
+        big = jax.device_put(
+            np.random.default_rng(0).integers(0, 256, (8192, 32)).astype(np.int32)
+        )
 
-        def _time(fn, reps=10):
-            out = fn(big, big)
-            jax.block_until_ready(out)  # compile + warm
-            t0 = _t.perf_counter()
-            for _ in range(reps):
-                out = fn(big, big)
-            jax.block_until_ready(out)
-            return (_t.perf_counter() - t0) / reps * 1e6
+        def _chain(mul_fn, m):
+            def f(x, y):
+                for _ in range(m):
+                    x = mul_fn(x, y)  # output limbs ≤ 293: invariant holds
+                return x
+            return jax.jit(f)
+
+        def _time(mul_fn, reps=5):
+            def run(m):
+                f = _chain(mul_fn, m)
+                np.asarray(f(big, big))  # compile + warm + sync
+                t0 = _t.perf_counter()
+                for _ in range(reps):
+                    out = f(big, big)
+                np.asarray(out)  # force execution
+                return (_t.perf_counter() - t0) / reps
+            return (run(33) - run(1)) / 32 * 1e6
+
+        gemm_mul = F._mul_gemm
+        pall_mul = pallas_field.mul
 
         gemm_us = _time(gemm_mul)
         pallas_us = _time(pall_mul)
@@ -269,19 +298,23 @@ def make_sharded_kernel(mesh, axis: str = "data"):
 
 
 def make_sharded_kernel_eq(mesh, axis: str = "data"):
-    """Multi-chip batch-equation verification: decompression and the
-    bucket MSM are data-parallel over the signature shard on each device
-    (zero communication); each device reduces its shard to ONE partial
-    point, and the only collective in the whole kernel is the all-gather
-    of those n_dev partials (a few KB over ICI). The replicated epilogue
-    adds the zs·B term and runs the cofactored identity check.
+    """Multi-chip batch-equation verification: R-point decompression and
+    the 16-window R-side MSM — the bulk of the work after A-side grouping
+    — are data-parallel over the signature shard on each device (zero
+    communication); each device reduces its shard to ONE partial point,
+    and the only collective in the whole kernel is the all-gather of
+    those n_dev partials (a few KB over ICI). The replicated epilogue
+    decompresses the G unique keys, runs the small grouped A-side MSM
+    (G+1 rows incl. the zs·B base-point term), and the cofactored
+    identity check.
 
-    Call with (a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid);
-    batch length must divide evenly by the mesh axis size.
+    Call with (ua_bytes, r_bytes, ga_digits, r_digits, zs_digits,
+    s_valid, gidx); the signature-axis length must divide evenly by the
+    mesh axis size.
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map
     except ImportError:  # older jax
@@ -292,39 +325,41 @@ def make_sharded_kernel_eq(mesh, axis: str = "data"):
 
     _ensure_compile_cache()
 
-    def local_partial(a_bytes, r_bytes, a_digits, r_digits, s_valid):
-        stacked, ok = curve.decompress(
-            jnp.concatenate([a_bytes, r_bytes], axis=0)
-        )
-        n = a_bytes.shape[0]
-        A = Point(*(c[:n] for c in stacked))
-        R = Point(*(c[n:] for c in stacked))
-        ok_bitmap = ok[:n] & ok[n:] & s_valid
-        ident = curve.identity((n,))
-        Am = curve.point_select(ok_bitmap, curve.point_neg(A), ident)
-        Rm = curve.point_select(ok_bitmap, curve.point_neg(R), ident)
-        part = curve.point_add(msm.msm(Am, a_digits), msm.msm(Rm, r_digits))
+    def local_partial(r_bytes, r_digits, s_valid):
+        R, r_ok = curve.decompress(r_bytes)
+        n = r_bytes.shape[0]
+        r_use = r_ok & s_valid
+        Rm = curve.point_select(r_use, curve.point_neg(R), curve.identity((n,)))
+        part = msm.msm(Rm, r_digits)
         # (1, 4, 32): the device's single partial point; the P(axis)
         # out_spec concatenates them to (n_dev, 4, 32) — XLA inserts the
         # gather collective where the replicated epilogue consumes it
-        return ok_bitmap, jnp.stack(list(part))[None]
+        return r_use, jnp.stack(list(part))[None]
 
     sharded = shard_map(
         local_partial,
         mesh=mesh,
-        in_specs=(P(axis), P(axis), P(None, axis), P(None, axis), P(axis)),
+        in_specs=(P(axis), P(None, axis), P(axis)),
         out_specs=(P(axis), P(axis)),
     )
 
-    def kernel(a_bytes, r_bytes, a_digits, r_digits, zs_digits, s_valid):
-        ok_bitmap, parts = sharded(a_bytes, r_bytes, a_digits, r_digits, s_valid)
+    def kernel(ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx):
+        r_use, parts = sharded(r_bytes, r_digits, s_valid)
         partial_pts = Point(*(parts[:, i] for i in range(4)))
         total = msm._tree_reduce_points(  # n_dev is a power of two
             partial_pts, axis=0
         )
+        # replicated epilogue: unique-key decompression + grouped A MSM
+        g = ua_bytes.shape[0]
+        A, a_ok = curve.decompress(ua_bytes)
+        Am = curve.point_select(a_ok, curve.point_neg(A), curve.identity((g,)))
         bpt = curve.base_point(())
-        sb = msm.msm(Point(*(c[None] for c in bpt)), zs_digits)
-        acc = curve.point_add(total, sb)
+        ga = Point(
+            *(jnp.concatenate([c, b[None]], axis=0) for c, b in zip(Am, bpt))
+        )
+        gd = jnp.concatenate([ga_digits, zs_digits], axis=1)
+        acc = curve.point_add(total, msm.msm(ga, gd))
+        ok_bitmap = jnp.take(a_ok, gidx) & r_use
         return ok_bitmap, curve.is_identity(curve.mul_by_cofactor(acc))
 
     return jax.jit(kernel)
@@ -426,46 +461,71 @@ def prepare_resolved(entries: list[ResolvedSig | None], pad_to: int = 0):
     )
 
 
+def _group_bucket(g: int) -> int:
+    """Pad the unique-key count so the A-side MSM length (G + 1 base-point
+    row) lands on a power of two ≥ 64 — stable compile shapes, and the
+    MSM's blocked prefix scan needs divisibility."""
+    b = _MIN_BUCKET
+    while b < g + 1:
+        b *= 2
+    return b - 1
+
+
 def prepare_batch_eq(entries: list[ResolvedSig | None], pad_to: int = 0):
     """Host prep for the batch-equation kernel. pad_to ≥ len(entries)
-    pads with inert rows (digits 0, s_valid False). Returns (a_bytes,
-    r_bytes, a_digits, r_digits, zs_digits, s_valid) numpy arrays shaped
-    for `_kernel_eq`."""
+    pads the signature axis with inert rows (digits 0, s_valid False);
+    the unique-key axis is padded to a group bucket. Returns (ua_bytes,
+    r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx) numpy arrays
+    shaped for `_kernel_eq`."""
     import os as _os
 
     n = len(entries)
     m = max(pad_to, n)
-    a_np = np.zeros((m, 32), np.uint8)
     r_np = np.zeros((m, 32), np.uint8)
-    a_sc = np.zeros((m, 32), np.uint8)  # z·k mod L bytes
     r_sc = np.zeros((m, 16), np.uint8)  # z bytes
     s_valid = np.zeros(m, bool)
+    gidx = np.zeros(m, np.int32)
+    group_of: dict[bytes, int] = {}
+    ua: list[bytes] = []
+    coeffs: list[int] = []  # per-group Σ z·k mod L
     zs = 0
     rnd = _os.urandom(16 * n)
     for i, e in enumerate(entries):
         if e is None:
             continue
+        gi = group_of.get(e.a)
+        if gi is None:
+            gi = group_of[e.a] = len(ua)
+            ua.append(e.a)
+            coeffs.append(0)
+        gidx[i] = gi
         s_valid[i] = True
-        a_np[i] = np.frombuffer(e.a, np.uint8)
         r_np[i] = np.frombuffer(e.r, np.uint8)
         # z ∈ [1, 2^128): |1 excludes zero (a zero coefficient would drop
         # the signature from the equation entirely)
         z = int.from_bytes(rnd[16 * i : 16 * i + 16], "little") | 1
-        a_sc[i] = np.frombuffer(((z * e.k) % L).to_bytes(32, "little"), np.uint8)
         r_sc[i] = np.frombuffer(z.to_bytes(16, "little"), np.uint8)
+        coeffs[gi] = (coeffs[gi] + z * e.k) % L
         zs = (zs + z * e.s) % L
+    gb = _group_bucket(len(ua))
+    ua_np = np.zeros((gb, 32), np.uint8)
+    ga_sc = np.zeros((gb, 32), np.uint8)
+    for gi, (key, c) in enumerate(zip(ua, coeffs)):
+        ua_np[gi] = np.frombuffer(key, np.uint8)
+        ga_sc[gi] = np.frombuffer(c.to_bytes(32, "little"), np.uint8)
     zs_digits = (
         np.frombuffer(zs.to_bytes(32, "little"), np.uint8)
         .astype(np.int32)
         .reshape(32, 1)
     )
     return (
-        a_np.astype(np.int32),
+        ua_np.astype(np.int32),
         r_np.astype(np.int32),
-        np.ascontiguousarray(a_sc.T).astype(np.int32),  # (32, m)
+        np.ascontiguousarray(ga_sc.T).astype(np.int32),  # (32, gb)
         np.ascontiguousarray(r_sc.T).astype(np.int32),  # (16, m)
         zs_digits,
         s_valid,
+        gidx,
     )
 
 

@@ -295,6 +295,33 @@ def test_verify_batch_eq_malformed_entries():
     assert not out[2] and not out[5] and out.sum() == 6
 
 
+def test_verify_batch_eq_bad_shared_pubkey():
+    """A-side grouping: one undecompressable pubkey shared by several
+    signatures must fail exactly those rows (the bitmap gathers the
+    per-GROUP decompression verdict through gidx)."""
+    from tendermint_tpu.crypto.tpu.verify import verify_batch_eq
+
+    from tendermint_tpu.crypto.ed25519_math import Point as IntPoint
+
+    items = _signed_items(20, n_vals=4)  # each key signs ~5 times
+    # find a y with no curve point (oracle-checked, deterministic)
+    bad_key = next(
+        k
+        for b0 in range(256)
+        for k in [bytes([b0]) + b"\x02" * 31]
+        if IntPoint.decompress(k) is None
+    )
+    bad_rows = [i for i, it in enumerate(items) if it[0] == items[1][0]]
+    items = [
+        (bad_key, m, s) if p == items[1][0] else (p, m, s)
+        for (p, m, s) in items
+    ]
+    out = verify_batch_eq(items)
+    assert len(bad_rows) >= 2
+    for i in range(20):
+        assert out[i] == (i not in bad_rows)
+
+
 def test_verify_resolved_sr25519():
     """sr25519 signatures route through the same MSM kernel."""
     from tendermint_tpu.crypto import sr25519 as sr
