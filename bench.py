@@ -141,6 +141,7 @@ def kernel_breakdown(items: list) -> dict:
     end-to-end time. Diagnostics only — production uses the fused kernel."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
     from tendermint_tpu.crypto.tpu import curve, msm
     from tendermint_tpu.crypto.tpu import verify as tpuv
@@ -166,7 +167,9 @@ def kernel_breakdown(items: list) -> dict:
         return (time.perf_counter() - t0) / reps
 
     dec = jax.jit(
-        lambda ab, rb: curve.decompress(jnp.concatenate([ab, rb], axis=0))
+        lambda ab, rb: curve.decompress(
+            jnp.concatenate([ab, rb], axis=0).astype(jnp.int32)
+        )
     )
     t_dec = timeit(dec, ua_bytes, r_bytes)
     stacked, _ok = dec(ua_bytes, r_bytes)
@@ -182,11 +185,13 @@ def kernel_breakdown(items: list) -> dict:
         )
     )
     r_pts = Point(*(jnp.asarray(c[gb : gb + b]) for c in stacked))
-    ga_full = jnp.concatenate([jnp.asarray(ga_digits), jnp.asarray(zs_digits)], axis=1)
+    ga_full = jnp.concatenate(
+        [jnp.asarray(ga_digits), jnp.asarray(zs_digits)], axis=1
+    ).astype(jnp.int32)
 
     msm_fn = jax.jit(msm.msm)
     t_msm_a = timeit(msm_fn, a_pts, ga_full)  # 32 windows, grouped + base row
-    t_msm_r = timeit(msm_fn, r_pts, jnp.asarray(r_digits))  # 16 windows
+    t_msm_r = timeit(msm_fn, r_pts, jnp.asarray(r_digits, jnp.int32))  # 16 windows
     t_full = timeit(
         jax.jit(tpuv._kernel_eq),
         ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx,

@@ -1,0 +1,219 @@
+"""abci-cli — exercise an ABCI application from the command line
+(reference abci/cmd/abci-cli/abci-cli.go:54).
+
+Subcommands mirror the reference's:
+
+  kvstore            serve the built-in kvstore app (socket or grpc)
+  echo|info|deliver_tx|check_tx|commit|query
+                     one request against a running app
+  console            interactive REPL — one request per line
+  batch              run a sequence of commands from stdin
+  test               scripted conformance sequence against a kvstore app
+                     (reference abci-cli.go:294 cmdTest)
+
+Tx / query arguments accept the reference's literal forms: raw strings,
+0xHEX, and "quoted strings".
+
+Usage: python -m tendermint_tpu.abci.cli <cmd> [args] [--address tcp://...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from .kvstore import KVStoreApp
+from .types import RequestCheckTx, RequestDeliverTx, RequestInfo, RequestQuery
+
+
+def _parse_bytes(s: str) -> bytes:
+    """Reference stringOrHexToBytes (abci-cli.go:646): 0x-prefixed hex,
+    double-quoted literal, or the raw string."""
+    if s.startswith("0x") or s.startswith("0X"):
+        return bytes.fromhex(s[2:])
+    if len(s) >= 2 and s[0] == '"' and s[-1] == '"':
+        return s[1:-1].encode()
+    return s.encode()
+
+
+def _addr(spec: str) -> tuple[str, str, int]:
+    """-> (scheme, host, port)."""
+    scheme = "tcp"
+    rest = spec
+    if "://" in spec:
+        scheme, rest = spec.split("://", 1)
+    host, port = rest.rsplit(":", 1)
+    return scheme, host, int(port)
+
+
+async def _client(spec: str):
+    scheme, host, port = _addr(spec)
+    if scheme == "grpc":
+        from .grpcnet import GrpcClient
+
+        c = GrpcClient(host, port)
+    else:
+        from .socket import SocketClient
+
+        c = SocketClient(host, port)
+    await c.start()
+    return c
+
+
+def _print(res: dict) -> None:
+    out = {}
+    for k in ("code", "data", "info", "log", "value", "height", "message"):
+        v = getattr(res, k, None)
+        if v in (None, "", b"", 0):
+            continue
+        out[k] = v.hex() if isinstance(v, (bytes, bytearray)) else v
+    code = getattr(res, "code", 0)
+    print(f"-> code: {'OK' if not code else code}")
+    for k, v in out.items():
+        if k != "code":
+            print(f"-> {k}: {v}")
+
+
+async def _run_one(client, cmd: str, args: list[str]) -> int:
+    if cmd == "echo":
+        msg = args[0] if args else ""
+        print(f"-> data: {await client.echo(msg)}")
+        return 0
+    if cmd == "info":
+        _print(await client.info(RequestInfo(version=args[0] if args else "")))
+        return 0
+    if cmd == "deliver_tx":
+        if not args:
+            print("-> code: 10\n-> log: want the tx")
+            return 0
+        _print(await client.deliver_tx(RequestDeliverTx(tx=_parse_bytes(args[0]))))
+        return 0
+    if cmd == "check_tx":
+        if not args:
+            print("-> code: 10\n-> info: want the tx")
+            return 0
+        _print(await client.check_tx(RequestCheckTx(tx=_parse_bytes(args[0]))))
+        return 0
+    if cmd == "commit":
+        res = await client.commit()
+        print(f"-> data.hex: 0x{res.data.hex().upper()}")
+        return 0
+    if cmd == "query":
+        if not args:
+            print("-> code: 10\n-> log: want the query")
+            return 0
+        res = await client.query(RequestQuery(data=_parse_bytes(args[0]), prove=True))
+        _print(res)
+        return 0
+    print(f"unknown command {cmd!r}", file=sys.stderr)
+    return 1
+
+
+async def _console(spec: str, lines) -> int:
+    client = await _client(spec)
+    try:
+        for line in lines:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            print(f"> {line}")
+            await _run_one(client, parts[0], parts[1:])
+    finally:
+        await client.stop()
+    return 0
+
+
+async def _serve_kvstore(spec: str, persist: str | None) -> int:
+    scheme, host, port = _addr(spec)
+    from ..store.db import SQLiteDB
+
+    app = KVStoreApp(SQLiteDB(persist)) if persist else KVStoreApp()
+    if scheme == "grpc":
+        from .grpcnet import GrpcABCIServer as Server
+    else:
+        from .socket import ABCIServer as Server
+    srv = Server(app)
+    await srv.start(host, port)
+    print(f"kvstore listening on {scheme}://{host}:{srv.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    await srv.stop()
+    return 0
+
+
+async def _test(spec: str) -> int:
+    """Scripted conformance pass against a kvstore app (reference
+    abci-cli.go:294): deliver a tx, expect it queryable, app hash moves."""
+    client = await _client(spec)
+    failures = 0
+
+    def check(name: str, ok: bool, detail: str = ""):
+        nonlocal failures
+        print(f"{'PASS' if ok else 'FAIL'}: {name}{' — ' + detail if detail and not ok else ''}")
+        if not ok:
+            failures += 1
+
+    try:
+        check("echo", (await client.echo("hi")) == "hi")
+        info = await client.info(RequestInfo())
+        check("info", hasattr(info, "last_block_height"))
+        res = await client.deliver_tx(RequestDeliverTx(tx=b"abci=works"))
+        check("deliver_tx", res.code == 0, f"code={res.code}")
+        c1 = await client.commit()
+        res = await client.query(RequestQuery(data=b"abci"))
+        check(
+            "query after commit",
+            res.code == 0 and res.value == b"works",
+            f"code={res.code} value={res.value!r}",
+        )
+        res = await client.check_tx(RequestCheckTx(tx=b"ok=1"))
+        check("check_tx", res.code == 0, f"code={res.code}")
+        await client.deliver_tx(RequestDeliverTx(tx=b"k2=v2"))
+        c2 = await client.commit()
+        check("app hash advances", c1.data != c2.data)
+    finally:
+        await client.stop()
+    print(json.dumps({"failures": failures}))
+    return 1 if failures else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="abci-cli", description=__doc__)
+    p.add_argument(
+        "--address", default="tcp://127.0.0.1:26658", help="app address (tcp:// or grpc://)"
+    )
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("echo", "info", "deliver_tx", "check_tx", "commit", "query"):
+        sp = sub.add_parser(name)
+        sp.add_argument("args", nargs="*")
+    sub.add_parser("console", help="interactive request REPL")
+    sub.add_parser("batch", help="requests from stdin, one per line")
+    sub.add_parser("test", help="kvstore conformance sequence")
+    skv = sub.add_parser("kvstore", help="serve the builtin kvstore app")
+    skv.add_argument("--persist", default=None, help="sqlite path (default in-memory)")
+    a = p.parse_args(argv)
+
+    if a.cmd == "kvstore":
+        return asyncio.run(_serve_kvstore(a.address, a.persist))
+    if a.cmd in ("console", "batch"):
+        return asyncio.run(_console(a.address, sys.stdin))
+    if a.cmd == "test":
+        return asyncio.run(_test(a.address))
+
+    async def one():
+        client = await _client(a.address)
+        try:
+            return await _run_one(client, a.cmd, a.args)
+        finally:
+            await client.stop()
+
+    return asyncio.run(one())
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

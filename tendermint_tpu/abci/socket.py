@@ -58,10 +58,12 @@ def _build_registry() -> dict:
         for name, cls in vars(abci).items()
         if isinstance(cls, type) and dataclasses.is_dataclass(cls)
     }
+    from ..crypto import merkle as _merkle
     from ..types import params as _params
 
-    # domain types embedded in ABCI requests (RequestBeginBlock.header,
-    # RequestInitChain.consensus_params …)
+    # domain types embedded in ABCI requests/responses
+    # (RequestBeginBlock.header, RequestInitChain.consensus_params,
+    # ResponseQuery.proof_ops …)
     for cls in (
         _block.Header,
         _block.BlockID,
@@ -72,6 +74,8 @@ def _build_registry() -> dict:
         _params.BlockParams,
         _params.EvidenceParams,
         _params.ValidatorParams,
+        _merkle.Proof,
+        _merkle.ProofOp,
     ):
         reg[cls.__name__] = cls
     return reg
@@ -221,10 +225,21 @@ class SocketClient(Client):
             while True:
                 frame = await _read_frame(self._reader)
                 fut = self._pending.popleft()
+                if fut.done():  # caller cancelled; nobody is listening
+                    continue
                 if "err" in frame:
                     fut.set_exception(RuntimeError(frame["err"]))
                 else:
-                    fut.set_result(_from_jsonable(frame.get("res")))
+                    try:
+                        fut.set_result(_from_jsonable(frame.get("res")))
+                    except Exception as e:  # noqa: BLE001 — codec mismatch
+                        # a response the codec can't decode must fail THIS
+                        # call, not silently kill the loop and hang every
+                        # later caller on a never-resolved future
+                        if not fut.done():
+                            fut.set_exception(
+                                RuntimeError(f"undecodable abci response: {e!r}")
+                            )
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError) as e:
             while self._pending:
                 fut = self._pending.popleft()

@@ -532,6 +532,29 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_signer_harness(args) -> int:
+    """Acceptance-test a remote signer (reference
+    tools/tm-signer-harness/main.go:1)."""
+    import logging
+
+    from .tools import signer_harness as sh
+
+    logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
+    expected = None
+    if args.genesis:
+        from .types.genesis import GenesisDoc
+
+        with open(args.genesis) as f:
+            doc = GenesisDoc.from_json(f.read())
+        if not doc.validators:
+            print("genesis has no validators", file=sys.stderr)
+            return sh.ERR_INVALID_PARAMS
+        expected = doc.validators[0].pub_key
+    return sh.run_harness(
+        args.addr, chain_id=args.chain_id, expected_pub_key=expected
+    )
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tendermint-tpu", description="TPU-native BFT consensus node"
@@ -571,6 +594,15 @@ def main(argv: list[str] | None = None) -> int:
         fn=cmd_inspect
     )
     sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    p_sh = sub.add_parser(
+        "signer-harness",
+        help="acceptance-test a remote signer (tm-signer-harness analog)",
+    )
+    p_sh.add_argument("--addr", required=True, help="tcp://h:p or grpc://h:p")
+    p_sh.add_argument("--chain-id", default="harness-chain")
+    p_sh.add_argument("--genesis", default="", help="pin identity to genesis validator[0]")
+    p_sh.set_defaults(fn=cmd_signer_harness)
 
     p_light = sub.add_parser("light", help="light-verify a height over RPC")
     p_light.add_argument("--address", default="http://127.0.0.1:26657")

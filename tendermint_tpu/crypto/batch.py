@@ -87,8 +87,10 @@ def _probe_tpu() -> None:
         if ok:
             # fallback=True also compiles the per-signature attribution
             # kernel: the first bad signature in a gossiped batch must not
-            # stall verification behind an inline JIT compile
-            warmup(fallback=True)
+            # stall verification behind an inline JIT compile. groups=150
+            # warms the grouped A-side at the bucket a realistic validator
+            # set lands on (gb=255), not just the all-padding floor shape
+            warmup(groups=150, fallback=True)
             _measure_cutoff()
         _tpu_available = ok
         logger.info("TPU batch verifier %s", "ready" if ok else "unavailable")

@@ -101,15 +101,21 @@ for _i in range(LIMBS):
 
 
 # When True, `mul` routes to the Pallas VMEM-resident convolution kernel
-# (pallas_field.py) instead of the portable GEMM formulation. Enabled by
-# the verify module after probing that Pallas actually runs on the active
-# backend; must be set BEFORE kernels are traced.
+# (pallas_field.py) instead of the portable GEMM formulation; separately,
+# _USE_PALLAS_POW routes pow22523 to the fused VMEM exponentiation chain.
+# The two are probed independently (verify._maybe_enable_pallas): a lone
+# Pallas mul pays transposes at every kernel boundary and can LOSE to the
+# GEMM inside big fused graphs, while the pow chain amortizes one
+# boundary over 254 multiplies and ~always wins. Must be set BEFORE
+# kernels are traced.
 _USE_PALLAS = False
+_USE_PALLAS_POW = False
 
 
-def set_pallas(on: bool) -> None:
-    global _USE_PALLAS
+def set_pallas(on: bool, *, pow_chain: bool | None = None) -> None:
+    global _USE_PALLAS, _USE_PALLAS_POW
     _USE_PALLAS = bool(on)
+    _USE_PALLAS_POW = bool(on if pow_chain is None else pow_chain)
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -197,7 +203,19 @@ def pow2k(a: jnp.ndarray, k: int) -> jnp.ndarray:
 
 def pow22523(z: jnp.ndarray) -> jnp.ndarray:
     """z^(2^252 - 3): the exponentiation used for inverse square roots in
-    decompression (classic ed25519 addition chain)."""
+    decompression (classic ed25519 addition chain). On Pallas-enabled
+    backends the whole 254-multiply chain runs as ONE VMEM-resident
+    kernel (pallas_field.pow22523) — per-squaring HBM round-trips cost
+    more than the arithmetic."""
+    if _USE_PALLAS_POW:
+        from . import pallas_field
+
+        return pallas_field.pow22523(z)
+    return _pow22523_chain(z)
+
+
+def _pow22523_chain(z: jnp.ndarray) -> jnp.ndarray:
+    """The portable XLA formulation (also the A/B-probe baseline)."""
     t0 = square(z)  # 2
     t1 = square(square(t0))  # 8
     t1 = mul(z, t1)  # 9

@@ -45,13 +45,16 @@ SEG = 64  # conv scratch sublanes (63 coefficients + 1 structural zero)
 TILE = 512  # lanes (batch elements) per grid step
 
 
-def _mul_kernel(a_ref, b_ref, o_ref):
-    a = a_ref[:].astype(jnp.float32)  # (32, T)
-    b = b_ref[:].astype(jnp.float32)  # (32, T)
-    t = a.shape[1]
+def _conv_mod(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply of (32, T) int32 limb blocks, used INSIDE Pallas
+    kernels (pure array in/out; callers read/write the refs). Same
+    exactness/carry-bound analysis as field.py's GEMM formulation."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    t = af.shape[1]
     acc = jnp.zeros((SEG, t), jnp.float32)
     for j in range(LIMBS):
-        prod = a * b[j : j + 1, :]  # (32, T), one sublane row broadcast
+        prod = af * bf[j : j + 1, :]  # (32, T), one sublane row broadcast
         acc = acc + jnp.pad(prod, ((j, SEG - LIMBS - j), (0, 0)))
 
     conv = acc.astype(jnp.int32)  # exact: every partial sum < 2^24
@@ -64,7 +67,50 @@ def _mul_kernel(a_ref, b_ref, o_ref):
         low = c & 0xFF
         carry = c >> 8
         c = low + jnp.concatenate([carry[31:] * 38, carry[:31]], axis=0)
-    o_ref[:] = c
+    return c
+
+
+def _mul_kernel(a_ref, b_ref, o_ref):
+    o_ref[:] = _conv_mod(a_ref[:], b_ref[:])
+
+
+def _pow22523_kernel(z_ref, o_ref):
+    """z^(2^252 − 3) with the ENTIRE 254-multiply addition chain resident
+    in VMEM. This is the inverse-square-root exponentiation that
+    dominates point decompression; as separate XLA ops every squaring
+    round-trips its (B,32) operand through HBM, which costs more than the
+    arithmetic. One fused kernel touches HBM exactly twice (load z, store
+    the result). Chain structure mirrors field.pow22523 (classic ed25519
+    ladder)."""
+    z = z_ref[:]
+
+    def sq(x, k=1):
+        for _ in range(k):
+            x = _conv_mod(x, x)
+        return x
+
+    t0 = sq(z)  # 2
+    t1 = sq(t0, 2)  # 8
+    t1 = _conv_mod(z, t1)  # 9
+    t0 = _conv_mod(t0, t1)  # 11
+    t0 = sq(t0)  # 22
+    t0 = _conv_mod(t1, t0)  # 31 = 2^5 - 1
+    t1 = sq(t0, 5)
+    t0 = _conv_mod(t1, t0)  # 2^10 - 1
+    t1 = sq(t0, 10)
+    t1 = _conv_mod(t1, t0)  # 2^20 - 1
+    t2 = sq(t1, 20)
+    t1 = _conv_mod(t2, t1)  # 2^40 - 1
+    t1 = sq(t1, 10)
+    t0 = _conv_mod(t1, t0)  # 2^50 - 1
+    t1 = sq(t0, 50)
+    t1 = _conv_mod(t1, t0)  # 2^100 - 1
+    t2 = sq(t1, 100)
+    t1 = _conv_mod(t2, t1)  # 2^200 - 1
+    t1 = sq(t1, 50)
+    t0 = _conv_mod(t1, t0)  # 2^250 - 1
+    t0 = sq(t0, 2)  # 2^252 - 4
+    o_ref[:] = _conv_mod(t0, z)  # 2^252 - 3
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -103,4 +149,36 @@ def mul(a: jnp.ndarray, b: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarr
         a2 = jnp.pad(a2, ((0, mp - m), (0, 0)))
         b2 = jnp.pad(b2, ((0, mp - m), (0, 0)))
     out = _mul_limbs_first(a2.T, b2.T, interpret=interpret)
+    return out.T[:m].reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pow22523_limbs_first(z_t: jnp.ndarray, interpret: bool = False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = z_t.shape[1]
+    return pl.pallas_call(
+        _pow22523_kernel,
+        out_shape=jax.ShapeDtypeStruct((LIMBS, m), jnp.int32),
+        grid=(m // TILE,),
+        in_specs=[
+            pl.BlockSpec((LIMBS, TILE), lambda i: (0, i), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(
+            (LIMBS, TILE), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+        interpret=interpret,
+    )(z_t)
+
+
+def pow22523(z: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarray:
+    """Drop-in for field.pow22523 — the fused VMEM exponentiation chain."""
+    shape = z.shape
+    m = int(np.prod(shape[:-1])) if shape[:-1] else 1
+    z2 = z.reshape(m, LIMBS)
+    mp = -(-m // TILE) * TILE
+    if mp != m:
+        z2 = jnp.pad(z2, ((0, mp - m), (0, 0)))
+    out = _pow22523_limbs_first(z2.T, interpret=interpret)
     return out.T[:m].reshape(shape)

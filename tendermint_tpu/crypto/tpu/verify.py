@@ -105,6 +105,12 @@ def _kernel_eq(ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx)
     from . import curve, msm
     from .curve import Point
 
+    # operands arrive as uint8 (host->device transfer is 4x smaller);
+    # all arithmetic runs in int32
+    ua_bytes, r_bytes, ga_digits, r_digits, zs_digits = (
+        x.astype(jnp.int32)
+        for x in (ua_bytes, r_bytes, ga_digits, r_digits, zs_digits)
+    )
     g = ua_bytes.shape[0]
     stacked, ok = curve.decompress(jnp.concatenate([ua_bytes, r_bytes], axis=0))
     A = Point(*(c[:g] for c in stacked))
@@ -210,35 +216,64 @@ def _maybe_enable_pallas() -> None:
                 return x
             return jax.jit(f)
 
-        def _time(mul_fn, reps=5):
-            def run(m):
-                f = _chain(mul_fn, m)
-                np.asarray(f(big, big))  # compile + warm + sync
-                t0 = _t.perf_counter()
-                for _ in range(reps):
-                    out = f(big, big)
-                np.asarray(out)  # force execution
-                return (_t.perf_counter() - t0) / reps
-            return (run(33) - run(1)) / 32 * 1e6
+        def _time(mul_fn, reps=3, m=65):
+            # TOTAL time of one long chain, not a two-run marginal: the
+            # per-dispatch sync floor (~5ms through the tunnel) dwarfs a
+            # single mul, and differencing two noisy runs has produced
+            # negative "marginals" that inverted the choice. A 65-chain
+            # puts the slower path several sync-floors above the faster
+            # one, so the comparison is robust to dispatch jitter.
+            f = _chain(mul_fn, m)
+            np.asarray(f(big, big))  # compile + warm + sync
+            t0 = _t.perf_counter()
+            for _ in range(reps):
+                out = f(big, big)
+            np.asarray(out)  # force execution
+            return (_t.perf_counter() - t0) / reps / m * 1e6
 
-        gemm_mul = F._mul_gemm
-        pall_mul = pallas_field.mul
-
-        gemm_us = _time(gemm_mul)
-        pallas_us = _time(pall_mul)
+        gemm_us = _time(F._mul_gemm)
+        pallas_us = _time(pallas_field.mul)
         use_pallas = pallas_us < gemm_us
+
+        # the fused pow22523 chain is probed SEPARATELY: it amortizes its
+        # layout boundary over 254 multiplies, so it can win even when a
+        # lone Pallas mul loses to the GEMM inside fused graphs.
+        want = np.asarray(jax.jit(F._pow22523_chain)(a))
+        got = np.asarray(pallas_field.pow22523(a))
+        if not all(
+            F.limbs_to_int(want[i]) == F.limbs_to_int(got[i]) for i in range(4)
+        ):
+            raise RuntimeError("pallas pow22523 mismatch")
+
+        def _time_pow(fn, reps=3):
+            np.asarray(fn(big))
+            t0 = _t.perf_counter()
+            for _ in range(reps):
+                out = fn(big)
+            np.asarray(out)
+            return (_t.perf_counter() - t0) / reps * 1e3
+
+        pow_xla_ms = _time_pow(jax.jit(F._pow22523_chain))
+        pow_pallas_ms = _time_pow(pallas_field.pow22523)
+        use_pallas_pow = pow_pallas_ms < pow_xla_ms
+
         field_mul_probe.update(
             gemm_us=round(gemm_us, 1),
             pallas_us=round(pallas_us, 1),
             chosen="pallas" if use_pallas else "gemm",
+            pow_xla_ms=round(pow_xla_ms, 1),
+            pow_pallas_ms=round(pow_pallas_ms, 1),
+            pow_chosen="pallas" if use_pallas_pow else "xla",
         )
         import logging
 
         logging.getLogger("crypto.tpu").info(
-            "field-mul A/B (8192-wide): gemm %.1fus pallas %.1fus -> %s",
+            "field-mul A/B (8192-wide): gemm %.1fus pallas %.1fus -> %s; "
+            "pow22523 xla %.1fms fused %.1fms -> %s",
             gemm_us, pallas_us, field_mul_probe["chosen"],
+            pow_xla_ms, pow_pallas_ms, field_mul_probe["pow_chosen"],
         )
-        F.set_pallas(use_pallas)
+        F.set_pallas(use_pallas, pow_chain=use_pallas_pow)
     except Exception as e:  # noqa: BLE001 — GEMM path keeps working
         import logging
 
@@ -268,14 +303,26 @@ def _get_kernel_eq():
     return _jitted_kernel_eq
 
 
-def warmup(bucket: int | None = None, *, fallback: bool = False) -> None:
+def warmup(
+    bucket: int | None = None, *, groups: int | None = None, fallback: bool = False
+) -> None:
     """Compile + execute the batch-equation kernel once at the floor
     bucket size so the first real batch pays neither backend init nor
     compile (the persistent compile cache makes this fast after the
-    first-ever process). fallback=True also warms the per-signature
-    attribution kernel (only exercised by bad batches)."""
-    n = bucket or _MIN_BUCKET
-    _get_kernel_eq()(*prepare_batch_eq([None] * n, pad_to=n))
+    first-ever process). `groups` warms the grouped A-side at the bucket
+    that many unique keys land on (a 150-validator set needs gb=255 —
+    a different static shape than the all-padding gb=63); fallback=True
+    also warms the per-signature attribution kernel (only exercised by
+    bad batches)."""
+    g = groups or 1
+    n = max(bucket or _MIN_BUCKET, _bucket(g))  # ≥1 signature per key
+    # distinct dummy keys pin the unique-key count; they need not
+    # decompress (shape is what compiles), but must be format-valid
+    entries: list[ResolvedSig | None] = [
+        ResolvedSig(i.to_bytes(4, "little") + b"\x00" * 28, b"\x01" + b"\x00" * 31, 0, 0)
+        for i in range(g)
+    ] + [None] * (n - g)
+    _get_kernel_eq()(*prepare_batch_eq(entries, pad_to=n))
     if fallback:
         _get_kernel()(*prepare_resolved([None] * n, pad_to=n))
 
@@ -326,6 +373,8 @@ def make_sharded_kernel_eq(mesh, axis: str = "data"):
     _ensure_compile_cache()
 
     def local_partial(r_bytes, r_digits, s_valid):
+        r_bytes = r_bytes.astype(jnp.int32)
+        r_digits = r_digits.astype(jnp.int32)
         R, r_ok = curve.decompress(r_bytes)
         n = r_bytes.shape[0]
         r_use = r_ok & s_valid
@@ -350,6 +399,9 @@ def make_sharded_kernel_eq(mesh, axis: str = "data"):
             partial_pts, axis=0
         )
         # replicated epilogue: unique-key decompression + grouped A MSM
+        ua_bytes = ua_bytes.astype(jnp.int32)
+        ga_digits = ga_digits.astype(jnp.int32)
+        zs_digits = zs_digits.astype(jnp.int32)
         g = ua_bytes.shape[0]
         A, a_ok = curve.decompress(ua_bytes)
         Am = curve.point_select(a_ok, curve.point_neg(A), curve.identity((g,)))
@@ -505,24 +557,22 @@ def prepare_batch_eq(entries: list[ResolvedSig | None], pad_to: int = 0):
         # the signature from the equation entirely)
         z = int.from_bytes(rnd[16 * i : 16 * i + 16], "little") | 1
         r_sc[i] = np.frombuffer(z.to_bytes(16, "little"), np.uint8)
-        coeffs[gi] = (coeffs[gi] + z * e.k) % L
-        zs = (zs + z * e.s) % L
+        # accumulate WITHOUT reducing: one mod per group at the end beats
+        # a 384-bit modular reduction per signature
+        coeffs[gi] += z * e.k
+        zs += z * e.s
     gb = _group_bucket(len(ua))
     ua_np = np.zeros((gb, 32), np.uint8)
     ga_sc = np.zeros((gb, 32), np.uint8)
     for gi, (key, c) in enumerate(zip(ua, coeffs)):
         ua_np[gi] = np.frombuffer(key, np.uint8)
-        ga_sc[gi] = np.frombuffer(c.to_bytes(32, "little"), np.uint8)
-    zs_digits = (
-        np.frombuffer(zs.to_bytes(32, "little"), np.uint8)
-        .astype(np.int32)
-        .reshape(32, 1)
-    )
+        ga_sc[gi] = np.frombuffer((c % L).to_bytes(32, "little"), np.uint8)
+    zs_digits = np.frombuffer((zs % L).to_bytes(32, "little"), np.uint8).reshape(32, 1)
     return (
-        ua_np.astype(np.int32),
-        r_np.astype(np.int32),
-        np.ascontiguousarray(ga_sc.T).astype(np.int32),  # (32, gb)
-        np.ascontiguousarray(r_sc.T).astype(np.int32),  # (16, m)
+        ua_np,  # uint8 throughout: the kernel casts on-device, the
+        r_np,  # host->device copy moves 4x fewer bytes
+        np.ascontiguousarray(ga_sc.T),  # (32, gb)
+        np.ascontiguousarray(r_sc.T),  # (16, m)
         zs_digits,
         s_valid,
         gidx,

@@ -1,0 +1,178 @@
+"""Remote-signer acceptance harness (reference
+tools/tm-signer-harness/internal/test_harness.go:52).
+
+Validates that a remote signer implementation — socket or gRPC attachment
+mode — speaks the privval protocol correctly before it is trusted with a
+real validator key:
+
+  1. TestPublicKey  — the signer's public key matches the expected
+     validator identity (genesis file or explicit key).
+  2. TestSignProposal — a canonical proposal comes back with a signature
+     that verifies against the advertised key.
+  3. TestSignVote   — prevote and precommit both sign and verify, and a
+     CONFLICTING vote at the same height/round/type is refused
+     (double-sign guard; reference test_harness.go:265-330).
+
+Each failure class maps to a distinct exit code (the reference's
+TestHarnessError model, test_harness.go:25-41) so CI scripts can tell a
+connection problem from a crypto failure.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import replace
+
+from ..crypto.hashes import sha256
+from ..privval import DoubleSignError
+from ..types.block import BlockID, PartSetHeader
+from ..types.keys import SignedMsgType
+from ..types.vote import Proposal, Vote
+
+# exit codes (reference test_harness.go:26-41)
+OK = 0
+ERR_INVALID_PARAMS = 1
+ERR_CONNECT_FAILED = 2
+ERR_TEST_PUBLIC_KEY_FAILED = 3
+ERR_TEST_SIGN_PROPOSAL_FAILED = 4
+ERR_TEST_SIGN_VOTE_FAILED = 5
+ERR_DOUBLE_SIGN_NOT_REFUSED = 6
+
+logger = logging.getLogger("tools.signer_harness")
+
+
+def _bid(tag: bytes) -> BlockID:
+    return BlockID(sha256(tag), PartSetHeader(1, sha256(b"parts:" + tag)))
+
+
+class SignerHarness:
+    """Drives the acceptance tests against a connected signer client.
+
+    `client` is anything implementing the PrivValidator surface backed by
+    a remote process (privval_remote.SignerClient or GrpcSignerClient);
+    `expected_pub_key` pins the identity (None skips the comparison)."""
+
+    def __init__(self, client, *, chain_id: str = "harness-chain",
+                 expected_pub_key=None, height: int = 100, round_: int = 0):
+        self.client = client
+        self.chain_id = chain_id
+        self.expected_pub_key = expected_pub_key
+        self.height = height
+        self.round = round_
+
+    # -- tests -----------------------------------------------------------
+
+    def test_public_key(self) -> int:
+        try:
+            pk = self.client.get_pub_key()
+        except Exception as e:  # noqa: BLE001 — transport failure class
+            logger.error("get_pub_key failed: %r", e)
+            return ERR_CONNECT_FAILED
+        if self.expected_pub_key is not None and (
+            pk.bytes() != self.expected_pub_key.bytes()
+            or pk.TYPE != self.expected_pub_key.TYPE
+        ):
+            logger.error(
+                "signer key mismatch: got %s/%s want %s/%s",
+                pk.TYPE, pk.bytes().hex(),
+                self.expected_pub_key.TYPE, self.expected_pub_key.bytes().hex(),
+            )
+            return ERR_TEST_PUBLIC_KEY_FAILED
+        logger.info("TestPublicKey OK (%s %s)", pk.TYPE, pk.address().hex())
+        return OK
+
+    def test_sign_proposal(self) -> int:
+        pk = self.client.get_pub_key()
+        prop = Proposal(
+            height=self.height,
+            round=self.round,
+            pol_round=-1,
+            block_id=_bid(b"harness-proposal"),
+            timestamp_ns=time.time_ns(),
+        )
+        try:
+            signed = self.client.sign_proposal(self.chain_id, prop)
+        except Exception as e:  # noqa: BLE001
+            logger.error("sign_proposal failed: %r", e)
+            return ERR_TEST_SIGN_PROPOSAL_FAILED
+        if not pk.verify_signature(signed.sign_bytes(self.chain_id), signed.signature):
+            logger.error("proposal signature does not verify")
+            return ERR_TEST_SIGN_PROPOSAL_FAILED
+        logger.info("TestSignProposal OK")
+        return OK
+
+    def test_sign_vote(self) -> int:
+        pk = self.client.get_pub_key()
+        for vtype in (SignedMsgType.PREVOTE, SignedMsgType.PRECOMMIT):
+            vote = Vote(
+                type=vtype,
+                height=self.height,
+                round=self.round,
+                block_id=_bid(b"harness-vote"),
+                timestamp_ns=time.time_ns(),
+                validator_address=pk.address(),
+                validator_index=0,
+            )
+            try:
+                signed = self.client.sign_vote(self.chain_id, vote)
+            except Exception as e:  # noqa: BLE001
+                logger.error("sign_vote(%s) failed: %r", vtype.name, e)
+                return ERR_TEST_SIGN_VOTE_FAILED
+            if not pk.verify_signature(
+                signed.sign_bytes(self.chain_id), signed.signature
+            ):
+                logger.error("%s signature does not verify", vtype.name)
+                return ERR_TEST_SIGN_VOTE_FAILED
+
+            # double-sign regression: a DIFFERENT block at the same
+            # height/round/type must be refused, not signed
+            conflict = replace(vote, block_id=_bid(b"harness-conflict"))
+            try:
+                self.client.sign_vote(self.chain_id, conflict)
+            except DoubleSignError:
+                logger.info("TestSignVote OK (%s; conflict refused)", vtype.name)
+                continue
+            except Exception as e:  # noqa: BLE001
+                logger.error("conflicting vote errored oddly: %r", e)
+                return ERR_TEST_SIGN_VOTE_FAILED
+            logger.error("signer SIGNED a conflicting %s (double-sign!)", vtype.name)
+            return ERR_DOUBLE_SIGN_NOT_REFUSED
+        return OK
+
+    def run(self) -> int:
+        """All tests in order; first failing exit code wins (reference
+        test_harness.go:137-191)."""
+        for test in (self.test_public_key, self.test_sign_proposal, self.test_sign_vote):
+            rc = test()
+            if rc != OK:
+                return rc
+        logger.info("SUCCESS! All tests passed.")
+        return OK
+
+
+def run_harness(addr: str, *, chain_id: str = "harness-chain",
+                expected_pub_key=None) -> int:
+    """Connect to `addr` (tcp://host:port socket privval protocol, or
+    grpc://host:port) and run the acceptance suite."""
+    from ..privval_remote import GrpcSignerClient, SignerClient
+
+    try:
+        if addr.startswith("grpc://"):
+            host, port = addr[len("grpc://"):].rsplit(":", 1)
+            client = GrpcSignerClient(host, int(port))
+        else:
+            hostport = addr[len("tcp://"):] if addr.startswith("tcp://") else addr
+            host, port = hostport.rsplit(":", 1)
+            client = SignerClient(host, int(port))
+    except (ValueError, OSError) as e:
+        logger.error("bad address %r: %r", addr, e)
+        return ERR_INVALID_PARAMS
+    try:
+        return SignerHarness(
+            client, chain_id=chain_id, expected_pub_key=expected_pub_key
+        ).run()
+    finally:
+        close = getattr(client, "close", None) or getattr(client, "_drop", None)
+        if close:
+            close()

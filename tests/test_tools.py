@@ -1,0 +1,139 @@
+"""Tools: signer acceptance harness (reference
+tools/tm-signer-harness/internal/test_harness_test.go) and abci-cli
+(reference abci/cmd/abci-cli)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.privval import FilePV, PrivValidator
+from tendermint_tpu.privval_remote import GrpcSignerServer, ThreadedSignerServer
+from tendermint_tpu.tools import signer_harness as sh
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def file_pv(tmp_path):
+    return FilePV.generate(str(tmp_path / "key.json"), str(tmp_path / "state.json"))
+
+
+def test_signer_harness_socket_pass(file_pv):
+    srv = ThreadedSignerServer(file_pv)
+    port = srv.start()
+    try:
+        rc = sh.run_harness(
+            f"tcp://127.0.0.1:{port}", expected_pub_key=file_pv.get_pub_key()
+        )
+        assert rc == sh.OK
+    finally:
+        srv.stop()
+
+
+def test_signer_harness_grpc_pass_and_identity_mismatch(file_pv, tmp_path):
+    other = FilePV.generate(str(tmp_path / "k2.json"), str(tmp_path / "s2.json"))
+    srv = GrpcSignerServer(file_pv)
+    port = srv.start()
+    try:
+        assert (
+            sh.run_harness(
+                f"grpc://127.0.0.1:{port}", expected_pub_key=file_pv.get_pub_key()
+            )
+            == sh.OK
+        )
+        assert (
+            sh.run_harness(
+                f"grpc://127.0.0.1:{port}", expected_pub_key=other.get_pub_key()
+            )
+            == sh.ERR_TEST_PUBLIC_KEY_FAILED
+        )
+    finally:
+        srv.stop()
+
+
+class _EquivocatingPV(PrivValidator):
+    """Signs anything — the broken signer the harness exists to catch."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def get_pub_key(self):
+        return self.inner.get_pub_key()
+
+    def sign_vote(self, chain_id, vote):
+        sig = self.inner.priv_key.sign(vote.sign_bytes(chain_id))
+        from dataclasses import replace
+
+        return replace(vote, signature=sig)
+
+    def sign_proposal(self, chain_id, proposal):
+        sig = self.inner.priv_key.sign(proposal.sign_bytes(chain_id))
+        from dataclasses import replace
+
+        return replace(proposal, signature=sig)
+
+
+def test_signer_harness_catches_double_signer(file_pv):
+    srv = ThreadedSignerServer(_EquivocatingPV(file_pv))
+    port = srv.start()
+    try:
+        rc = sh.run_harness(f"tcp://127.0.0.1:{port}")
+        assert rc == sh.ERR_DOUBLE_SIGN_NOT_REFUSED
+    finally:
+        srv.stop()
+
+
+# -- abci-cli ---------------------------------------------------------------
+
+
+def _wait_listening(proc, timeout=30.0):
+    t0 = time.time()
+    line = proc.stdout.readline()
+    assert "listening" in line, line
+    assert time.time() - t0 < timeout
+
+
+@pytest.mark.parametrize("scheme", ["tcp", "grpc"])
+def test_abci_cli_conformance(scheme, unused_tcp_port_factory=None):
+    port = 37000 + (os.getpid() + (0 if scheme == "tcp" else 1)) % 2000
+    addr = f"{scheme}://127.0.0.1:{port}"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    server = subprocess.Popen(
+        [sys.executable, "-m", "tendermint_tpu.abci.cli", "--address", addr, "kvstore"],
+        cwd=REPO,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        _wait_listening(server)
+        out = subprocess.run(
+            [sys.executable, "-m", "tendermint_tpu.abci.cli", "--address", addr, "test"],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert '"failures": 0' in out.stdout
+        # proven query roundtrip over the wire (ProofOp codec)
+        out = subprocess.run(
+            [
+                sys.executable, "-m", "tendermint_tpu.abci.cli",
+                "--address", addr, "query", "abci",
+            ],
+            cwd=REPO,
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 0 and "code: OK" in out.stdout, out.stdout + out.stderr
+    finally:
+        server.terminate()
+        server.wait(timeout=10)

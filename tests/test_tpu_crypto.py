@@ -360,6 +360,25 @@ def test_pallas_field_mul_matches_gemm():
     got = np.asarray(PF.mul(a, b, interpret=True))
     for i in range(len(a)):
         assert F.limbs_to_int(want[i]) == F.limbs_to_int(got[i])
+
+
+def test_pallas_pow22523_matches_xla_chain():
+    """The fused VMEM pow22523 kernel (interpret mode on CPU) agrees with
+    the portable XLA addition chain — and with exact integer math."""
+    import numpy as np
+
+    from tendermint_tpu.crypto.tpu import field as F
+    from tendermint_tpu.crypto.tpu import pallas_field as PF
+
+    rng = np.random.default_rng(13)
+    z = rng.integers(0, 256, (9, 32), dtype=np.int32)
+    want = np.asarray(F._pow22523_chain(z))
+    got = np.asarray(PF.pow22523(z, interpret=True))
+    for i in range(len(z)):
+        zi = F.limbs_to_int(z[i])
+        expect = pow(zi, 2**252 - 3, F.P_INT)
+        assert F.limbs_to_int(want[i]) == expect
+        assert F.limbs_to_int(got[i]) == expect
     assert got.max() < 512  # module invariant preserved
 
     # extreme-bound exactness (511 everywhere — the f32 worst case)
