@@ -159,3 +159,60 @@ def test_batch_dispatch():
     assert create_batch_verifier(ed) is not None
     with pytest.raises(ValueError):
         create_batch_verifier(sec)
+
+
+class TestXChaCha20Poly1305:
+    """Reference crypto/xchacha20poly1305/vector_test.go vectors."""
+
+    HCHACHA_VECTORS = [
+        # (key, nonce16, keystream) — reference vector_test.go:36-63 (the
+        # 24-byte nonces there feed only their first 16 bytes to HChaCha20)
+        ("00" * 32, "00" * 16,
+         "1140704c328d1d5d0e30086cdf209dbd6a43b8f41518a11cc387b669b2ee6586"),
+        ("80" + "00" * 31, "00" * 16,
+         "7d266a7fd808cae4c02a0a70dcbfbcc250dae65ce3eae7fc210f54cc8f77df86"),
+        ("00" * 31 + "01", "00" * 15 + "00",
+         None),  # vector 3 uses nonce ...02 in byte 23, outside HChaCha input
+        ("000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f",
+         "000102030405060708090a0b0c0d0e0f",
+         "51e3ff45a895675c4b33b46c64f4a9ace110d34df6a2ceab486372bacbd3eff6"),
+        ("24f11cce8a1b3d61e441561a696c1c1b7e173d084fd4812425435a8896a013dc",
+         "d9660c5900ae19ddad28d6e06e45fe5e",
+         "5966b3eec3bff1189f831f06afe4d4e3be97fa9235ec8c20d08acfbbb4e851e3"),
+    ]
+
+    def test_hchacha20_vectors(self):
+        from tendermint_tpu.crypto.xchacha20poly1305 import hchacha20
+
+        for key_h, nonce_h, want_h in self.HCHACHA_VECTORS:
+            if want_h is None:
+                continue
+            got = hchacha20(bytes.fromhex(key_h), bytes.fromhex(nonce_h))
+            assert got.hex() == want_h
+
+    def test_seal_open_roundtrip_and_forgery(self):
+        import os
+
+        import pytest
+        from cryptography.exceptions import InvalidTag
+
+        from tendermint_tpu.crypto.xchacha20poly1305 import XChaCha20Poly1305
+
+        key = os.urandom(32)
+        aead = XChaCha20Poly1305(key)
+        nonce = os.urandom(24)
+        ct = aead.seal(nonce, b"attack at dawn", b"header")
+        assert aead.open(nonce, ct, b"header") == b"attack at dawn"
+        # forgery / wrong aad / wrong nonce all fail
+        with pytest.raises(InvalidTag):
+            aead.open(nonce, ct[:-1] + bytes([ct[-1] ^ 1]), b"header")
+        with pytest.raises(InvalidTag):
+            aead.open(nonce, ct, b"other")
+        with pytest.raises(InvalidTag):
+            aead.open(os.urandom(24), ct, b"header")
+
+    def test_distinct_nonce_prefix_changes_subkey(self):
+        from tendermint_tpu.crypto.xchacha20poly1305 import hchacha20
+
+        k = bytes(range(32))
+        assert hchacha20(k, bytes(16)) != hchacha20(k, b"\x01" + bytes(15))
