@@ -171,8 +171,11 @@ class TestXChaCha20Poly1305:
          "1140704c328d1d5d0e30086cdf209dbd6a43b8f41518a11cc387b669b2ee6586"),
         ("80" + "00" * 31, "00" * 16,
          "7d266a7fd808cae4c02a0a70dcbfbcc250dae65ce3eae7fc210f54cc8f77df86"),
-        ("00" * 31 + "01", "00" * 15 + "00",
-         None),  # vector 3 uses nonce ...02 in byte 23, outside HChaCha input
+        # vector 3's 24-byte nonce has its only nonzero byte at index 23,
+        # outside HChaCha20's 16-byte input — the Go harness truncates, so
+        # the expectation holds for an all-zero nonce16
+        ("00" * 31 + "01", "00" * 16,
+         "e0c77ff931bb9163a5460c02ac281c2b53d792b1c43fea817e9ad275ae546963"),
         ("000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f",
          "000102030405060708090a0b0c0d0e0f",
          "51e3ff45a895675c4b33b46c64f4a9ace110d34df6a2ceab486372bacbd3eff6"),
@@ -185,8 +188,6 @@ class TestXChaCha20Poly1305:
         from tendermint_tpu.crypto.xchacha20poly1305 import hchacha20
 
         for key_h, nonce_h, want_h in self.HCHACHA_VECTORS:
-            if want_h is None:
-                continue
             got = hchacha20(bytes.fromhex(key_h), bytes.fromhex(nonce_h))
             assert got.hex() == want_h
 
