@@ -62,6 +62,13 @@ class BlockSyncReactor(Service):
         self.pool = BlockPool(state.last_block_height + 1)
         self.synced = asyncio.Event()  # set on caught-up (switch to consensus)
         self.metrics = {"blocks_applied": 0, "sigs_verified": 0, "ranges": 0}
+        # commits ≤ this height are signature-proven by a range batch (or
+        # the sequential fallback) against the validator set whose hash is
+        # recorded alongside; lets apply_block skip the redundant host
+        # re-verification of each block's LastCommit. Reset on redo(): a
+        # re-fetched block can carry a different commit.
+        self._commit_verified_upto = 0
+        self._commit_verified_vals = b""
 
     async def on_start(self) -> None:
         self.spawn(self._process_peer_updates(), name="bsr.peers")
@@ -192,6 +199,8 @@ class BlockSyncReactor(Service):
             dt = time.monotonic() - t0
             self.metrics["ranges"] += 1
             self.metrics["sigs_verified"] += n_sigs
+            self._commit_verified_upto = first_height + len(entries) - 1
+            self._commit_verified_vals = assumed_vals.hash()
             self.logger.debug(
                 "verified range h=%d..%d (%d sigs) in %.1fms",
                 first_height,
@@ -236,6 +245,11 @@ class BlockSyncReactor(Service):
                 except InvalidCommitError as e:
                     await self._punish(height, provider, next_provider, e)
                     return
+                # record the re-proof so the NEXT block's apply doesn't
+                # redo this commit on the host (same bookkeeping as the
+                # sequential fallback)
+                self._commit_verified_upto = max(self._commit_verified_upto, height)
+                self._commit_verified_vals = self.state.validators.hash()
             if not await self._apply_one(block, block_id, parts, next_block, provider):
                 return
         return
@@ -265,6 +279,11 @@ class BlockSyncReactor(Service):
             except InvalidCommitError as e:
                 await self._punish(height, provider, next_provider, e)
                 return
+            # commit for `height` proven against the TRUE set for that
+            # height (state.validators now == state.last_validators when
+            # block height+1 is applied next iteration)
+            self._commit_verified_upto = max(self._commit_verified_upto, height)
+            self._commit_verified_vals = self.state.validators.hash()
             if not await self._apply_one(block, block_id, parts, next_block, provider):
                 return
 
@@ -279,6 +298,17 @@ class BlockSyncReactor(Service):
         if next_provider != provider:
             await self.channel.error(PeerError(next_provider, f"bad commit: {err}"))
         self.pool.redo(height, provider, next_provider)
+        self._commit_verified_upto = min(self._commit_verified_upto, height - 1)
+
+    def _commit_preverified(self, height: int) -> bool:
+        """True when block `height`'s LastCommit (the commit for
+        height-1) was already signature-proven by a batch/sequential
+        verification against exactly the set validate_block will check
+        it with (state.last_validators)."""
+        return (
+            height - 1 <= self._commit_verified_upto
+            and self.state.last_validators.hash() == self._commit_verified_vals
+        )
 
     async def _apply_one(self, block, block_id, parts, next_block, provider) -> bool:
         height = block.header.height
@@ -286,13 +316,17 @@ class BlockSyncReactor(Service):
             if self.block_store.height() < height:
                 self.block_store.save_block(block, parts, next_block.last_commit)
             self.state, _ = await self.block_exec.apply_block(
-                self.state, block_id, block
+                self.state,
+                block_id,
+                block,
+                commit_verified=self._commit_preverified(height),
             )
             self.metrics["blocks_applied"] += 1
         except Exception as e:
             self.logger.error("apply failed at height %d: %r", height, e)
             await self.channel.error(PeerError(provider, f"apply: {e!r}"))
             self.pool.redo(height, provider)
+            self._commit_verified_upto = min(self._commit_verified_upto, height - 1)
             return False
         self.pool.pop(height)
         return True

@@ -144,18 +144,27 @@ class BlockExecutor:
 
     # -- validation ------------------------------------------------------
 
-    def validate_block(self, state: State, block: Block) -> None:
-        validate_block(state, block)
+    def validate_block(
+        self, state: State, block: Block, *, commit_verified: bool = False
+    ) -> None:
+        validate_block(state, block, commit_verified=commit_verified)
         self.evidence_pool.check_evidence(block.evidence)
 
     # -- apply -----------------------------------------------------------
 
     async def apply_block(
-        self, state: State, block_id: BlockID, block: Block
+        self,
+        state: State,
+        block_id: BlockID,
+        block: Block,
+        *,
+        commit_verified: bool = False,
     ) -> tuple[State, int]:
         """Execute a committed block against the app and advance state
-        (reference execution.go:151). Returns (new_state, retain_height)."""
-        self.validate_block(state, block)
+        (reference execution.go:151). Returns (new_state, retain_height).
+        commit_verified: the caller proved LastCommit's signatures already
+        (block-sync range batches; see state/validation.py)."""
+        self.validate_block(state, block, commit_verified=commit_verified)
 
         responses = await self._exec_block(state, block)
         # crash points 4-5 mirror execution.go:170-217's fail.Fail sites

@@ -8,7 +8,7 @@ and the proposer's membership."""
 from __future__ import annotations
 
 from ..types.block import Block
-from ..types.validation import verify_commit
+from ..types.validation import _basic_commit_checks, verify_commit
 from .state import State
 
 
@@ -42,7 +42,14 @@ def median_time(commit, validators) -> int:
     return pairs[-1][0]
 
 
-def validate_block(state: State, block: Block) -> None:
+def validate_block(
+    state: State, block: Block, *, commit_verified: bool = False
+) -> None:
+    """commit_verified=True skips the LastCommit SIGNATURE check (every
+    structural check still runs): block-sync range batches prove whole
+    windows of commits in one device MSM (blocksync/reactor.py
+    _verify_and_apply), and re-verifying each one on the host during
+    apply would redo ~half the sync's total signature work."""
     block.validate_basic()
 
     h = block.header
@@ -84,13 +91,24 @@ def validate_block(state: State, block: Block) -> None:
                 f"LastCommit has {len(block.last_commit.signatures)} signatures, "
                 f"expected {len(state.last_validators)}"
             )
-        verify_commit(
-            state.chain_id,
-            state.last_validators,
-            state.last_block_id,
-            state.last_block_height,
-            block.last_commit,
-        )
+        if not commit_verified:
+            verify_commit(
+                state.chain_id,
+                state.last_validators,
+                state.last_block_id,
+                state.last_block_height,
+                block.last_commit,
+            )
+        else:
+            # signatures proven by the caller's batch; the cheap
+            # consistency checks still run (validate_basic already ran
+            # via block.validate_basic above)
+            _basic_commit_checks(
+                state.last_validators,
+                state.last_block_id,
+                state.last_block_height,
+                block.last_commit,
+            )
         # canonical block time is the weighted median of the commit votes
         expected_time = median_time(block.last_commit, state.last_validators)
         if h.time_ns != expected_time:

@@ -88,6 +88,7 @@ class ValidatorSet:
         vals.sort(key=lambda v: (-v.voting_power, v.address))
         self.validators = vals
         self._proposer: Validator | None = None
+        self._hash: bytes | None = None  # memo; priorities don't affect it
         if self.total_voting_power() > MAX_TOTAL_VOTING_POWER:
             raise ValueError("total voting power exceeds maximum")
         if vals:
@@ -172,6 +173,7 @@ class ValidatorSet:
         new = object.__new__(ValidatorSet)
         new.validators = [v.copy() for v in self.validators]
         new._proposer = None
+        new._hash = self._hash  # same keys/powers -> same hash
         if self._proposer is not None:
             idx, _ = new.get_by_address(self._proposer.address)
             new._proposer = new.validators[idx] if idx >= 0 else None
@@ -215,13 +217,23 @@ class ValidatorSet:
         new_vals.sort(key=lambda v: (-v.voting_power, v.address))
         self.validators = new_vals
         self._proposer = None
+        self._hash = None
         self.rescale_priorities(PRIORITY_WINDOW_SIZE_FACTOR * total)
         self._shift_by_avg_proposer_priority()
 
     # -- hashing / serialization ----------------------------------------
 
     def hash(self) -> bytes:
-        return hash_from_byte_slices([v.simple_encode() for v in self.validators])
+        """Merkle root of the simple-encoded validators (reference
+        types/validator_set.go:77-109 region). Memoized: the hash covers
+        pubkeys + powers only, which change solely through
+        update_with_change_set (proposer-priority churn doesn't touch it),
+        and hot paths (block-sync rotation guards) call this per block."""
+        if self._hash is None:
+            self._hash = hash_from_byte_slices(
+                [v.simple_encode() for v in self.validators]
+            )
+        return self._hash
 
     def encode(self) -> bytes:
         out = b""
@@ -247,6 +259,7 @@ class ValidatorSet:
         new = object.__new__(cls)
         new.validators = vals
         new._proposer = None
+        new._hash = None
         if proposer_addr:
             idx, v = new.get_by_address(proposer_addr)
             new._proposer = v

@@ -375,3 +375,55 @@ def test_query_parse_and_match():
 
     q4 = Query.parse("tx.height=7")
     assert q4.matches({"tx.height": ["7"]})
+
+
+def test_validate_block_commit_verified_skips_only_signatures():
+    """commit_verified=True (block-sync range batches already proved the
+    LastCommit on-device) skips ONLY the signature check: structural
+    tampering must still be rejected."""
+
+    async def run():
+        import dataclasses
+
+        h = Harness()
+        state = await h.handshake()
+        state, commit = await h.advance(state, None, [b"x=1"])
+
+        proposer = state.validators.get_proposer().address
+        block, parts = h.executor.create_proposal_block(2, state, commit, proposer)
+
+        # corrupt one commit signature: default validation rejects,
+        # commit_verified accepts (the caller vouches for signatures)
+        sigs = list(block.last_commit.signatures)
+        s0 = sigs[0]
+        sigs[0] = dataclasses.replace(
+            s0, signature=s0.signature[:63] + bytes([s0.signature[63] ^ 1])
+        )
+        bad_commit = dataclasses.replace(
+            block.last_commit, signatures=tuple(sigs)
+        )
+        forged = dataclasses.replace(
+            block,
+            header=dataclasses.replace(
+                block.header, last_commit_hash=bad_commit.hash()
+            ),
+            last_commit=bad_commit,
+        )
+        with pytest.raises(Exception):
+            h.executor.validate_block(state, forged)
+        h.executor.validate_block(state, forged, commit_verified=True)
+
+        # structural damage is still caught with commit_verified=True:
+        # height mismatch inside the commit
+        wrong_h = dataclasses.replace(block.last_commit, height=99)
+        broken = dataclasses.replace(
+            block,
+            header=dataclasses.replace(
+                block.header, last_commit_hash=wrong_h.hash()
+            ),
+            last_commit=wrong_h,
+        )
+        with pytest.raises(Exception):
+            h.executor.validate_block(state, broken, commit_verified=True)
+
+    asyncio.run(run())
