@@ -320,10 +320,10 @@ def bench_light_client(n_headers: int, n_vals: int) -> float:
     period = 10 * 365 * 24 * 3600 * 10**9
     now_ns = base_ts + (n_headers + 10) * 1_000_000_000
     t0 = _t.perf_counter()
-    trusted = blocks[0]
-    for lb in blocks[1:]:
-        verifier.verify_adjacent(chain_id, trusted, lb, period, now_ns)
-        trusted = lb
+    trusted = verifier.verify_adjacent_chain(
+        chain_id, blocks[0], blocks[1:], period, now_ns
+    )
+    assert trusted.height == n_headers
     dt = _t.perf_counter() - t0
     rate = (n_headers - 1) / dt
     log(f"light: verified {n_headers-1} adjacent headers in {dt:.2f}s -> {rate:,.1f} headers/s")

@@ -611,14 +611,35 @@ def _get_sharded(n_dev: int):
     return kernels
 
 
+#: Largest single-kernel batch: bigger ranges are split into chunks of
+#: this size. Bounds XLA compile shapes AND pipelines naturally — chunk
+#: k+1's host prep runs while chunk k executes (async dispatch); the
+#: bitmaps are only synced after every chunk is in flight.
+_MAX_BUCKET = int(os.environ.get("TMTPU_MAX_BUCKET", "8192"))
+
+
+def _select_kernels(n: int, pad_multiple: int):
+    """(kernel_eq, kernel_sig, padded_bucket) for an n-entry chunk."""
+    n_dev = _shard_device_count()
+    use_sharded = n_dev > 1 and (
+        os.environ.get("TMTPU_FORCE_SHARDED") == "1" or n >= _MIN_BUCKET * n_dev
+    )
+    if use_sharded:
+        mult = pad_multiple * n_dev // _gcd(pad_multiple, n_dev)
+        kernel_eq, kernel_sig = _get_sharded(n_dev)
+        return kernel_eq, kernel_sig, _bucket(n, mult)
+    return _get_kernel_eq(), _get_kernel(), _bucket(n, pad_multiple)
+
+
 def verify_resolved(
     entries: list[ResolvedSig | None], pad_multiple: int = 1
 ) -> np.ndarray:
     """Batch-equation verification with per-signature fallback: returns a
     bool bitmap of length len(entries). The happy path (all signatures
-    valid) costs one MSM kernel call; a failed equation falls back to the
-    per-signature kernel to recover the bitmap (the reference bisects
-    inside voi; attribution cost only matters on the rare bad batch).
+    valid) costs one MSM kernel call per ≤_MAX_BUCKET chunk; a failed
+    equation falls back to the per-signature kernel for THAT chunk only
+    (the reference bisects inside voi; attribution cost only matters on
+    the rare bad batch).
 
     Multi-device: when more than one accelerator is visible and the batch
     is large enough that every shard still fills a floor bucket, the MSM
@@ -630,22 +651,23 @@ def verify_resolved(
     n = len(entries)
     if n == 0:
         return np.zeros(0, bool)
-    n_dev = _shard_device_count()
-    use_sharded = n_dev > 1 and (
-        os.environ.get("TMTPU_FORCE_SHARDED") == "1" or n >= _MIN_BUCKET * n_dev
-    )
-    if use_sharded:
-        mult = pad_multiple * n_dev // _gcd(pad_multiple, n_dev)
-        b = _bucket(n, mult)
-        kernel_eq, kernel_sig = _get_sharded(n_dev)
-    else:
-        b = _bucket(n, pad_multiple)
-        kernel_eq, kernel_sig = _get_kernel_eq(), _get_kernel()
-    bitmap, eq_ok = kernel_eq(*prepare_batch_eq(entries, pad_to=b))
-    if bool(eq_ok):
-        return np.asarray(bitmap)[:n]
-    out = np.asarray(kernel_sig(*prepare_resolved(entries, pad_to=b)))
-    return out[:n]
+    # dispatch every chunk before syncing any: the device works on chunk
+    # k while the host preps (sha-free, but still bigint) chunk k+1
+    in_flight = []
+    for i in range(0, n, _MAX_BUCKET):
+        chunk = entries[i : i + _MAX_BUCKET]
+        kernel_eq, kernel_sig, b = _select_kernels(len(chunk), pad_multiple)
+        in_flight.append(
+            (chunk, kernel_sig, b, kernel_eq(*prepare_batch_eq(chunk, pad_to=b)))
+        )
+    outs = []
+    for chunk, kernel_sig, b, (bitmap, eq_ok) in in_flight:
+        if bool(eq_ok):
+            outs.append(np.asarray(bitmap)[: len(chunk)])
+        else:
+            out = np.asarray(kernel_sig(*prepare_resolved(chunk, pad_to=b)))
+            outs.append(out[: len(chunk)])
+    return outs[0] if len(outs) == 1 else np.concatenate(outs)
 
 
 def verify_batch_eq(

@@ -21,6 +21,7 @@ from ..types.validation import (
     InvalidCommitError,
     verify_commit_light,
     verify_commit_light_trusting,
+    verify_commit_range,
 )
 from .types import LightBlock
 
@@ -89,6 +90,64 @@ def verify_adjacent(
         )
     except InvalidCommitError as e:
         raise VerificationError(f"invalid commit: {e}") from e
+
+
+def verify_adjacent_chain(
+    chain_id: str,
+    trusted: LightBlock,
+    chain: list[LightBlock],
+    trusting_period_ns: int,
+    now_ns: int | None = None,
+    max_clock_drift_ns: int = 10 * 1_000_000_000,
+) -> LightBlock:
+    """Bulk sequential verification — the TPU-first shape of the
+    reference's header-by-header VerifyAdjacent loop
+    (light/client_benchmark_test.go drives exactly this workload).
+
+    All structural and trust-linkage checks (adjacency, expiry, times,
+    next_validators_hash pinning) run sequentially on the host — they are
+    cheap and order-dependent — and then every header's commit signatures
+    are proven in ONE range-batched verifier call
+    (types/validation.py:verify_commit_range), so a 1 000-header catch-up
+    is a handful of MSM kernel launches instead of 1 000. Since each
+    header's validator set is pinned by its predecessor's
+    next_validators_hash BEFORE any signature is checked, deferring the
+    signature proof to the end does not weaken the trust chain: a forged
+    commit anywhere fails the batch and nothing is returned.
+
+    Returns the new trusted head (the last block of `chain`). Raises
+    VerificationError naming the offending height otherwise."""
+    now_ns = time.time_ns() if now_ns is None else now_ns
+    entries = []
+    prev = trusted
+    for lb in chain:
+        if lb.height != prev.height + 1:
+            raise VerificationError(
+                f"chain not adjacent at height {lb.height} (prev {prev.height})"
+            )
+        if _expired(prev, trusting_period_ns, now_ns):
+            raise VerificationError(f"trusted header {prev.height} has expired")
+        _validate_untrusted(chain_id, prev, lb, now_ns, max_clock_drift_ns)
+        if lb.header.validators_hash != prev.header.next_validators_hash:
+            raise VerificationError(
+                f"validators hash mismatch at height {lb.height}"
+            )
+        entries.append(
+            (
+                lb.validators,
+                lb.signed_header.commit.block_id,
+                lb.height,
+                lb.signed_header.commit,
+            )
+        )
+        prev = lb
+    try:
+        verify_commit_range(chain_id, entries)
+    except InvalidCommitError as e:
+        idx = getattr(e, "failed_index", None)
+        at = f" at height {chain[idx].height}" if idx is not None else ""
+        raise VerificationError(f"invalid commit{at}: {e}") from e
+    return prev
 
 
 def verify_non_adjacent(

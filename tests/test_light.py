@@ -53,6 +53,54 @@ class TestVerifier:
             verifier.verify_adjacent(chain_id, lb2, lb1, LONG_NS, now)
 
     @pytest.mark.asyncio
+    async def test_adjacent_chain_bulk(self):
+        """verify_adjacent_chain == the sequential verify_adjacent loop:
+        same acceptance, same rejection (with height attribution), one
+        range-batched signature proof instead of per-header calls."""
+        import dataclasses
+
+        net, provider = await run_chain(heights=6)
+        chain_id = net.genesis.chain_id
+        blocks = [await provider.light_block(h) for h in range(1, 6)]
+        now = blocks[-1].header.time_ns + 1_000_000_000
+
+        head = verifier.verify_adjacent_chain(
+            chain_id, blocks[0], blocks[1:], LONG_NS, now
+        )
+        assert head.height == blocks[-1].height
+
+        # non-adjacent gap rejected
+        with pytest.raises(VerificationError):
+            verifier.verify_adjacent_chain(
+                chain_id, blocks[0], blocks[2:], LONG_NS, now
+            )
+
+        # tampered commit rejected, naming the right height
+        lb3 = blocks[2]
+        sigs = list(lb3.signed_header.commit.signatures)
+        s0 = sigs[0]
+        sigs[0] = dataclasses.replace(
+            s0, signature=s0.signature[:63] + bytes([s0.signature[63] ^ 1])
+        )
+        bad = LightBlock(
+            SignedHeader(
+                lb3.header,
+                dataclasses.replace(
+                    lb3.signed_header.commit, signatures=tuple(sigs)
+                ),
+            ),
+            lb3.validators,
+        )
+        with pytest.raises(VerificationError, match=str(lb3.height)):
+            verifier.verify_adjacent_chain(
+                chain_id,
+                blocks[0],
+                [blocks[1], bad, blocks[3], blocks[4]],
+                LONG_NS,
+                now,
+            )
+
+    @pytest.mark.asyncio
     async def test_expired_trust_rejected(self):
         net, provider = await run_chain(heights=3)
         chain_id = net.genesis.chain_id

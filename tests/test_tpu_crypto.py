@@ -387,3 +387,17 @@ def test_pallas_pow22523_matches_xla_chain():
     g = np.asarray(PF.mul(am, am, interpret=True))
     for i in range(5):
         assert F.limbs_to_int(w[i]) == F.limbs_to_int(g[i])
+
+
+def test_verify_resolved_chunked(monkeypatch):
+    """Batches above _MAX_BUCKET split into pipelined chunks; a bad
+    signature triggers the per-signature fallback ONLY for its chunk."""
+    from tendermint_tpu.crypto.tpu import verify as V
+
+    monkeypatch.setattr(V, "_MAX_BUCKET", 64)
+    items = _signed_items(150, n_vals=8)
+    p, m, s = items[100]  # chunk 2 (64..127)
+    items[100] = (p, m, s[:63] + bytes([s[63] ^ 1]))
+    out = V.verify_batch_eq(items)
+    assert len(out) == 150
+    assert not out[100] and out.sum() == 149
