@@ -10,10 +10,15 @@ light/detector.go:28)."""
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from dataclasses import dataclass
 from fractions import Fraction
+
+
+async def _as_ready(value):
+    return value
 
 from ..store.db import DB, MemDB
 from . import verifier
@@ -178,10 +183,22 @@ class LightClient:
         h = trusted.height + 1
         while h <= target.height:
             top = min(h + window - 1, target.height)
-            chain = [
-                target if hh == target.height else await self.primary.light_block(hh)
-                for hh in range(h, top + 1)
-            ]
+            # fetches are independent (verification is deferred to the
+            # end of the window), so issue them concurrently — over a
+            # real provider the serial RPC round-trips dominate, not the
+            # signature math
+            chain = list(
+                await asyncio.gather(
+                    *(
+                        (
+                            _as_ready(target)
+                            if hh == target.height
+                            else self.primary.light_block(hh)
+                        )
+                        for hh in range(h, top + 1)
+                    )
+                )
+            )
             trusted = verifier.verify_adjacent_chain(
                 self.chain_id, trusted, chain, self.trust_options.period_ns, now_ns
             )

@@ -61,6 +61,31 @@ def _expired(trusted: LightBlock, trusting_period_ns: int, now_ns: int) -> bool:
     return trusted.header.time_ns + trusting_period_ns <= now_ns
 
 
+def _check_adjacent_link(
+    chain_id: str,
+    trusted: LightBlock,
+    untrusted: LightBlock,
+    trusting_period_ns: int,
+    now_ns: int,
+    max_clock_drift_ns: int,
+) -> None:
+    """Every non-signature check of one adjacent step — shared verbatim
+    by verify_adjacent and verify_adjacent_chain so the two paths cannot
+    drift."""
+    if untrusted.height != trusted.height + 1:
+        raise VerificationError(
+            f"headers must be adjacent in height "
+            f"({trusted.height} -> {untrusted.height})"
+        )
+    if _expired(trusted, trusting_period_ns, now_ns):
+        raise VerificationError(f"trusted header {trusted.height} has expired")
+    _validate_untrusted(chain_id, trusted, untrusted, now_ns, max_clock_drift_ns)
+    if untrusted.header.validators_hash != trusted.header.next_validators_hash:
+        raise VerificationError(
+            "untrusted validators hash != trusted next_validators_hash"
+        )
+
+
 def verify_adjacent(
     chain_id: str,
     trusted: LightBlock,
@@ -71,15 +96,9 @@ def verify_adjacent(
 ) -> None:
     """Reference VerifyAdjacent verifier.go:103."""
     now_ns = time.time_ns() if now_ns is None else now_ns
-    if untrusted.height != trusted.height + 1:
-        raise VerificationError("headers must be adjacent in height")
-    if _expired(trusted, trusting_period_ns, now_ns):
-        raise VerificationError("trusted header has expired")
-    _validate_untrusted(chain_id, trusted, untrusted, now_ns, max_clock_drift_ns)
-    if untrusted.header.validators_hash != trusted.header.next_validators_hash:
-        raise VerificationError(
-            "untrusted validators hash != trusted next_validators_hash"
-        )
+    _check_adjacent_link(
+        chain_id, trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
+    )
     try:
         verify_commit_light(
             chain_id,
@@ -121,17 +140,9 @@ def verify_adjacent_chain(
     entries = []
     prev = trusted
     for lb in chain:
-        if lb.height != prev.height + 1:
-            raise VerificationError(
-                f"chain not adjacent at height {lb.height} (prev {prev.height})"
-            )
-        if _expired(prev, trusting_period_ns, now_ns):
-            raise VerificationError(f"trusted header {prev.height} has expired")
-        _validate_untrusted(chain_id, prev, lb, now_ns, max_clock_drift_ns)
-        if lb.header.validators_hash != prev.header.next_validators_hash:
-            raise VerificationError(
-                f"validators hash mismatch at height {lb.height}"
-            )
+        _check_adjacent_link(
+            chain_id, prev, lb, trusting_period_ns, now_ns, max_clock_drift_ns
+        )
         entries.append(
             (
                 lb.validators,

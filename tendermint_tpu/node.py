@@ -86,6 +86,12 @@ class NodeConfig:
     seed_mode: bool = False
     # persistent address book path; empty keeps addresses in memory only
     addr_book_path: str = ""
+    # event-loop liveness watchdog (libs/watchdog.py — the asyncio analog
+    # of the reference's deadlock-detecting mutexes, internal/libs/sync/
+    # deadlock.go): dump all stacks to this dir when the loop wedges
+    # longer than watchdog_threshold_s. Empty disables.
+    watchdog_dir: str = ""
+    watchdog_threshold_s: float = 5.0
 
 
 class Node(Service):
@@ -207,6 +213,14 @@ class Node(Service):
     # -- lifecycle -------------------------------------------------------
 
     async def on_start(self) -> None:
+        if self.config.watchdog_dir:
+            from .libs.watchdog import LoopWatchdog
+
+            self.watchdog = LoopWatchdog(
+                self.config.watchdog_dir,
+                threshold_s=self.config.watchdog_threshold_s,
+            )
+            self.watchdog.start()
         if self.config.seed_mode:
             # seed nodes never touch the app or stores: router + PEX only
             self.pex_reactor = PexReactor(
@@ -439,6 +453,8 @@ class Node(Service):
         await self.consensus.start()
 
     async def on_stop(self) -> None:
+        if getattr(self, "watchdog", None) is not None:
+            self.watchdog.stop()
         if self.rpc_server is not None:
             try:
                 await self.rpc_server.stop()

@@ -72,3 +72,41 @@ def test_bitarray_bytes_roundtrip():
     a = BitArray.from_indices(20, [0, 13, 19])
     b = BitArray.from_bytes(20, a.to_bytes())
     assert a == b
+
+
+class TestLoopWatchdog:
+    def test_wedged_loop_dumps_stacks_once(self, tmp_path):
+        import asyncio
+        import time
+
+        from tendermint_tpu.libs.watchdog import LoopWatchdog
+
+        async def main():
+            wd = LoopWatchdog(str(tmp_path), threshold_s=0.3, interval_s=0.1)
+            wd.start()
+            await asyncio.sleep(0.2)  # loop healthy: no report
+            assert wd.reports == []
+            time.sleep(1.0)  # wedge the loop (blocking sleep inline)
+            await asyncio.sleep(0.5)  # recover; watchdog re-arms
+            wd.stop()
+            return wd.reports
+
+        reports = asyncio.run(main())
+        assert len(reports) == 1, reports
+        text = open(reports[0]).read()
+        assert "event loop unresponsive" in text
+        assert "thread" in text
+
+    def test_healthy_loop_never_reports(self, tmp_path):
+        import asyncio
+
+        from tendermint_tpu.libs.watchdog import LoopWatchdog
+
+        async def main():
+            wd = LoopWatchdog(str(tmp_path), threshold_s=0.5, interval_s=0.05)
+            wd.start()
+            await asyncio.sleep(0.8)
+            wd.stop()
+            return wd.reports
+
+        assert asyncio.run(main()) == []
