@@ -193,7 +193,7 @@ class Runner:
             # SIGKILL + restart on the same stores (WAL/handshake recovery)
             proc.send_signal(signal.SIGKILL)
             proc.wait(timeout=10)
-            self.wait_network_progress(observer, 2, 120)
+            self.wait_network_progress(observer, 2, 240)
             self.spawn(spec)
         elif kind == "restart":
             # graceful stop + restart
@@ -214,7 +214,7 @@ class Runner:
             # it must re-dial and catch up (the no-container analog of
             # docker network disconnect)
             os.killpg(proc.pid, signal.SIGSTOP)
-            self.wait_network_progress(observer, 2, 120)
+            self.wait_network_progress(observer, 2, 240)
             time.sleep(8)
             os.killpg(proc.pid, signal.SIGCONT)
         else:
@@ -234,10 +234,10 @@ class Runner:
         observer = next(n.name for n in self.m.nodes if n.mode == "validator")
         for spec in starters:
             if spec.mode != "seed":
-                self.wait_height(spec.name, self.m.target_height, 180)
+                self.wait_height(spec.name, self.m.target_height, 300)
 
         for spec in late:
-            self.wait_height(observer, spec.start_at, 180)
+            self.wait_height(observer, spec.start_at, 300)
             if spec.state_sync:
                 trust_h = max(1, self.height(observer) - 8)
                 trust_hash = self.rpc(
@@ -251,15 +251,15 @@ class Runner:
                 cfg.statesync.trust_hash = trust_hash
                 open(cfg_path, "w").write(config_to_toml(cfg))
             self.spawn(spec)
-            self.wait_height(spec.name, self.height(observer), 180)
+            self.wait_height(spec.name, self.height(observer), 300)
 
         for spec in self.m.nodes:
             for kind in spec.perturb:
                 self.perturb(spec, kind, observer)
                 # every perturbation must heal: the node returns to the
                 # network tip (reference perturb.go waits for progress)
-                self.wait_network_progress(observer, 2, 120)
-                self.wait_height(spec.name, self.height(observer), 180)
+                self.wait_network_progress(observer, 2, 240)
+                self.wait_height(spec.name, self.height(observer), 300)
 
         self.assert_convergence()
 
