@@ -165,3 +165,44 @@ class TestAddressFuzz:
                 NodeAddress.parse("".join(buf))
             except ValueError:
                 pass
+
+
+class TestBatchVerifyFuzz:
+    def test_grouped_chunked_verify_vs_oracle(self, monkeypatch):
+        """Randomized differential: batches with duplicated keys, bad
+        signatures, tampered messages, malformed keys/sigs, and forced
+        small chunking must produce exactly the per-signature oracle's
+        bitmap (grouping + chunk pipelining are pure optimizations)."""
+        from tendermint_tpu.crypto import ed25519
+        from tendermint_tpu.crypto.ed25519_math import verify_zip215
+        from tendermint_tpu.crypto.tpu import verify as V
+
+        monkeypatch.setattr(V, "_MAX_BUCKET", 64)
+        rng = _rng(42)
+        keys = [ed25519.Ed25519PrivKey.generate() for _ in range(5)]
+        for trial in range(4):
+            items = []
+            n = rng.randrange(3, 140)
+            for i in range(n):
+                k = keys[rng.randrange(len(keys))]
+                msg = bytes(rng.randrange(256) for _ in range(rng.randrange(1, 60)))
+                sig = k.sign(msg)
+                roll = rng.random()
+                if roll < 0.12:  # corrupt signature byte
+                    b = rng.randrange(64)
+                    sig = sig[:b] + bytes([sig[b] ^ (1 + rng.randrange(255))]) + sig[b + 1:]
+                elif roll < 0.2:  # tamper message
+                    msg = msg + b"!"
+                elif roll < 0.25:  # malformed pubkey length
+                    items.append((k.pub_key().bytes()[:-1], msg, sig))
+                    continue
+                elif roll < 0.3:  # malformed sig length
+                    items.append((k.pub_key().bytes(), msg, sig[:-2]))
+                    continue
+                items.append((k.pub_key().bytes(), msg, sig))
+            got = V.verify_batch_eq(items)
+            want = [
+                len(p) == 32 and len(s) == 64 and verify_zip215(p, m, s)
+                for p, m, s in items
+            ]
+            assert list(got) == want, f"trial {trial}: mismatch"
