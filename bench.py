@@ -38,6 +38,13 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+#: Commits per TPU range at 150 validators: two full 8192-signature
+#: chunks (verify_resolved's _MAX_BUCKET), so host prep of chunk 2
+#: overlaps chunk 1's device execution. Used for BOTH the headline batch
+#: and the blocksync window so the two benches measure the same shape.
+TPU_RANGE_COMMITS = 2 * 8192 // 150  # 108
+
+
 def _reexec(env_updates: dict, reason: str) -> None:
     """Replace this process with a fresh run of the benchmark. A hung
     thread inside xla_bridge.backends() holds jax's global backend lock,
@@ -486,8 +493,7 @@ def main() -> None:
         # chip: tiny batch, one bucket, secondary configs skipped
         default_commits, reps = "3", 1
     else:
-        # enough commits that the padded batch lands on the 8192 bucket
-        default_commits = "54"
+        default_commits = str(TPU_RANGE_COMMITS)
     n_commits = int(os.environ.get("TMTPU_BENCH_COMMITS", default_commits))
 
     n_vals = 150
@@ -589,7 +595,7 @@ def main() -> None:
             log(f"light bench failed: {e!r}")
         try:
             extra["blocksync_blocks_per_s"] = round(
-                bench_blocksync(1024, n_vals, window=54), 1
+                bench_blocksync(1024, n_vals, window=TPU_RANGE_COMMITS), 1
             )
         except Exception as e:  # noqa: BLE001
             log(f"blocksync bench failed: {e!r}")
