@@ -131,3 +131,22 @@ class TestFullNodeNetwork:
             assert b3.hash() == net.nodes[0].block_store.load_block(3).hash()
         finally:
             await net.stop()
+
+
+class TestNodeWatchdog:
+    @pytest.mark.asyncio
+    async def test_watchdog_wired_and_clean_shutdown(self, tmp_path):
+        """watchdog_dir config starts the loop watchdog with the node and
+        stops it on shutdown without wedging the stop path itself."""
+        net = NodeNet(1)
+        node = net.nodes[0]
+        node.config.watchdog_dir = str(tmp_path / "wd")
+        node.config.watchdog_threshold_s = 30.0  # never fires in-test
+        await node.start()
+        try:
+            assert node.watchdog is not None
+            assert node.watchdog._thread.is_alive()
+        finally:
+            await node.stop()
+        assert not node.watchdog._thread.is_alive()
+        assert node.watchdog.reports == []
