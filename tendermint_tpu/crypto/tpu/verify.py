@@ -652,11 +652,16 @@ def verify_resolved(
     if n == 0:
         return np.zeros(0, bool)
     # dispatch every chunk before syncing any: the device works on chunk
-    # k while the host preps (sha-free, but still bigint) chunk k+1
+    # k while the host preps (sha-free, but still bigint) chunk k+1.
+    # A multi-chunk batch uses ONE compile shape for every chunk (tail
+    # padded to the full chunk size): stable shapes beat saving padding
+    # rows at the cost of an inline XLA compile of a one-off tail bucket.
+    kernel_eq, kernel_sig, b = _select_kernels(
+        _MAX_BUCKET if n > _MAX_BUCKET else n, pad_multiple
+    )
     in_flight = []
     for i in range(0, n, _MAX_BUCKET):
         chunk = entries[i : i + _MAX_BUCKET]
-        kernel_eq, kernel_sig, b = _select_kernels(len(chunk), pad_multiple)
         in_flight.append(
             (chunk, kernel_sig, b, kernel_eq(*prepare_batch_eq(chunk, pad_to=b)))
         )
