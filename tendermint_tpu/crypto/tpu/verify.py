@@ -648,25 +648,36 @@ def verify_resolved(
     bucket. TMTPU_FORCE_SHARDED=1 drops the size gate (tests);
     TMTPU_NO_SHARDED=1 disables sharding. One interface regardless of
     topology — the reference's crypto/crypto.go:46-54 contract."""
-    n = len(entries)
+    return _dispatch_and_collect(
+        len(entries),
+        lambda i, j: entries[i:j],
+        pad_multiple,
+    )
+
+
+def _dispatch_and_collect(n: int, get_entries, pad_multiple: int) -> np.ndarray:
+    """Chunked dispatch core: get_entries(i, j) materializes (resolves)
+    the entries of one chunk, CALLED AS THE LOOP RUNS — so with multiple
+    chunks, chunk k+1's host work (SHA-512 resolve + bigint prep)
+    overlaps chunk k's device execution via async dispatch. Every chunk
+    of a multi-chunk batch shares ONE compile shape (tail padded to the
+    full chunk size): stable shapes beat saving padding rows at the cost
+    of an inline XLA compile of a one-off tail bucket. Bitmaps are only
+    synced after every chunk is in flight; a failed equation falls back
+    to the per-signature kernel for that chunk alone."""
     if n == 0:
         return np.zeros(0, bool)
-    # dispatch every chunk before syncing any: the device works on chunk
-    # k while the host preps (sha-free, but still bigint) chunk k+1.
-    # A multi-chunk batch uses ONE compile shape for every chunk (tail
-    # padded to the full chunk size): stable shapes beat saving padding
-    # rows at the cost of an inline XLA compile of a one-off tail bucket.
     kernel_eq, kernel_sig, b = _select_kernels(
         _MAX_BUCKET if n > _MAX_BUCKET else n, pad_multiple
     )
     in_flight = []
     for i in range(0, n, _MAX_BUCKET):
-        chunk = entries[i : i + _MAX_BUCKET]
+        chunk = get_entries(i, min(i + _MAX_BUCKET, n))
         in_flight.append(
-            (chunk, kernel_sig, b, kernel_eq(*prepare_batch_eq(chunk, pad_to=b)))
+            (chunk, kernel_eq(*prepare_batch_eq(chunk, pad_to=b)))
         )
     outs = []
-    for chunk, kernel_sig, b, (bitmap, eq_ok) in in_flight:
+    for chunk, (bitmap, eq_ok) in in_flight:
         if bool(eq_ok):
             outs.append(np.asarray(bitmap)[: len(chunk)])
         else:
@@ -678,10 +689,13 @@ def verify_resolved(
 def verify_batch_eq(
     items: list[tuple[bytes, bytes, bytes]], pad_multiple: int = 1
 ) -> np.ndarray:
-    """(pubkey32, msg, sig64) ed25519 triples -> bool bitmap."""
-    return verify_resolved(
-        [resolve_ed25519(pub, msg, sig) for pub, msg, sig in items],
-        pad_multiple=pad_multiple,
+    """(pubkey32, msg, sig64) ed25519 triples -> bool bitmap. Resolution
+    (the SHA-512 per signature) happens per chunk inside the dispatch
+    loop, so for multi-chunk batches it overlaps device execution."""
+    return _dispatch_and_collect(
+        len(items),
+        lambda i, j: [resolve_ed25519(*it) for it in items[i:j]],
+        pad_multiple,
     )
 
 
