@@ -74,6 +74,72 @@ def _mul_kernel(a_ref, b_ref, o_ref):
     o_ref[:] = _conv_mod(a_ref[:], b_ref[:])
 
 
+# -- fused in-block prefix scan of cached point additions -------------------
+#
+def _eight_p():
+    """8·p as (32, 1) limbs, built from scalar literals INSIDE the kernel
+    (Pallas rejects captured array constants): p's little-endian bytes
+    are [0xED, 0xFF×30, 0x7F], so 8p's limbs are [1896, 2040×30, 1016].
+    Keeps subtraction non-negative with limbs < 2^12 before the carry
+    passes (field.py's EIGHT_P, same bounds analysis)."""
+    return jnp.concatenate(
+        [
+            jnp.full((1, 1), 8 * 0xED, jnp.int32),
+            jnp.full((30, 1), 8 * 0xFF, jnp.int32),
+            jnp.full((1, 1), 8 * 0x7F, jnp.int32),
+        ],
+        axis=0,
+    )
+
+
+def _carry1(c):
+    low = c & 0xFF
+    carry = c >> 8
+    return low + jnp.concatenate([carry[31:] * 38, carry[:31]], axis=0)
+
+
+def _fsub(a, b):
+    return _carry1(_carry1(a + _eight_p() - b))
+
+
+def _fadd(a, b):
+    return _carry1(a + b)
+
+
+def _add_cached(px, py, pz, pt, ymx, ypx, t2d, z2):
+    """curve.add_cached in the (32, T) transposed layout: complete
+    twisted-Edwards addition of an extended point and a cached ('Niels')
+    operand — 8 field multiplies, all VMEM-resident."""
+    a = _conv_mod(_fsub(py, px), ymx)
+    b = _conv_mod(_fadd(py, px), ypx)
+    c = _conv_mod(pt, t2d)
+    d = _conv_mod(pz, z2)
+    e = _fsub(b, a)
+    f = _fsub(d, c)
+    g = _fadd(d, c)
+    h = _fadd(b, a)
+    return _conv_mod(e, f), _conv_mod(g, h), _conv_mod(f, g), _conv_mod(e, h)
+
+
+def _scan_block_kernel(fx, fy, fz, ft, ymx, ypx, t2d, z2, ox, oy, oz, ot):
+    """Within-block inclusive prefix sums of point additions with the
+    ENTIRE 16-step chain VMEM-resident (the MSM's dominant stage; as
+    separate XLA ops every step round-trips four extended coordinates
+    through HBM).
+
+    Inputs: first point of each block (32, T) ×4; cached operands for
+    steps 1..B-1 (B-1, 32, T) ×4. Outputs: inclusive prefixes
+    (B, 32, T) ×4 (prefix 0 = the first point)."""
+    px, py, pz, pt = fx[:], fy[:], fz[:], ft[:]
+    ox[0], oy[0], oz[0], ot[0] = px, py, pz, pt
+    steps = ymx.shape[0]
+    for j in range(steps):  # static unroll: B-1 = 15 additions
+        px, py, pz, pt = _add_cached(
+            px, py, pz, pt, ymx[j], ypx[j], t2d[j], z2[j]
+        )
+        ox[j + 1], oy[j + 1], oz[j + 1], ot[j + 1] = px, py, pz, pt
+
+
 def _pow22523_kernel(z_ref, o_ref):
     """z^(2^252 − 3) with the ENTIRE 254-multiply addition chain resident
     in VMEM. This is the inverse-square-root exponentiation that
@@ -150,6 +216,65 @@ def mul(a: jnp.ndarray, b: jnp.ndarray, *, interpret: bool = False) -> jnp.ndarr
         b2 = jnp.pad(b2, ((0, mp - m), (0, 0)))
     out = _mul_limbs_first(a2.T, b2.T, interpret=interpret)
     return out.T[:m].reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "tile"))
+def _scan_blocks_limbs_first(first4, cached4, interpret: bool = False, tile: int = TILE):
+    """first4: 4 × (32, M); cached4: 4 × (B-1, 32, M); -> 4 × (B, 32, M)
+    inclusive prefixes. M a multiple of `tile`."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    m = first4[0].shape[1]
+    nb = cached4[0].shape[0] + 1
+    point_spec = pl.BlockSpec(
+        (LIMBS, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+    )
+    cached_spec = pl.BlockSpec(
+        (nb - 1, LIMBS, tile), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+    )
+    out_spec = pl.BlockSpec(
+        (nb, LIMBS, tile), lambda i: (0, 0, i), memory_space=pltpu.VMEM
+    )
+    outs = pl.pallas_call(
+        _scan_block_kernel,
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((nb, LIMBS, m), jnp.int32) for _ in range(4)
+        ),
+        grid=(m // tile,),
+        in_specs=[point_spec] * 4 + [cached_spec] * 4,
+        out_specs=tuple([out_spec] * 4),
+        interpret=interpret,
+    )(*first4, *cached4)
+    return outs
+
+
+def scan_blocks(first_pt, rest_cached, *, interpret: bool = False, tile: int = TILE):
+    """Fused within-block prefix scan. first_pt: 4 coord arrays (G, 32);
+    rest_cached: 4 cached arrays (B-1, G, 32). Returns 4 prefix arrays
+    (G, B, 32) — inclusive, prefix 0 = first point. Drop-in for the
+    lax.scan in msm._boundary_prefixes. `tile` shrinks the lane tile for
+    cheap interpret-mode testing."""
+    g = first_pt[0].shape[0]
+    gp = -(-g // tile) * tile
+    pad = gp - g
+
+    def tr_point(c):  # (G, 32) -> (32, Gp)
+        c = jnp.pad(c, ((0, pad), (0, 0))) if pad else c
+        return c.T
+
+    def tr_cached(c):  # (B-1, G, 32) -> (B-1, 32, Gp)
+        c = jnp.pad(c, ((0, 0), (0, pad), (0, 0))) if pad else c
+        return jnp.swapaxes(c, 1, 2)
+
+    outs = _scan_blocks_limbs_first(
+        tuple(tr_point(c) for c in first_pt),
+        tuple(tr_cached(c) for c in rest_cached),
+        interpret=interpret,
+        tile=tile,
+    )
+    # (B, 32, Gp) -> (G, B, 32)
+    return tuple(jnp.moveaxis(o, 2, 0)[:g] for o in outs)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

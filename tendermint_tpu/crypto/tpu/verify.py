@@ -257,6 +257,57 @@ def _maybe_enable_pallas() -> None:
         pow_pallas_ms = _time_pow(pallas_field.pow22523)
         use_pallas_pow = pow_pallas_ms < pow_xla_ms
 
+        # fused within-block scan probe, run through the PRODUCTION trace
+        # shape — msm.msm with 16 vmapped windows at the 8192 bucket (the
+        # R-side MSM): the pallas_call must survive the vmap batching
+        # rule, the g==TILE routing gate, and the full sort/scan/collapse
+        # graph before it is trusted. Operand "points" are random limb
+        # vectors — both paths compute identical limb algebra whether or
+        # not the inputs lie on the curve, so equality + timing transfer.
+        from . import msm as msm_mod
+
+        rng2 = np.random.default_rng(1)
+        pts = tuple(
+            jax.device_put(rng2.integers(0, 256, (8192, 32), dtype=np.int32))
+            for _ in range(4)
+        )
+        digs = jax.device_put(
+            rng2.integers(0, 256, (16, 8192), dtype=np.int32)
+        )
+        from .curve import Point as _Pt
+
+        def _run_msm(flag):
+            msm_mod.set_pallas_scan(flag)
+            try:
+                fn = jax.jit(lambda p, d: msm_mod.msm(_Pt(*p), d))
+                out = fn(pts, digs)
+                canon = np.asarray(F.canonical(jnp.stack(list(out))))
+                t0 = _t.perf_counter()
+                for _ in range(3):
+                    out = fn(pts, digs)
+                np.asarray(out[0])
+                return canon, (_t.perf_counter() - t0) / 3 * 1e3
+            finally:
+                msm_mod.set_pallas_scan(False)
+
+        scan_ok = False
+        try:
+            want, scan_xla_ms = _run_msm(False)
+            got, scan_pallas_ms = _run_msm(True)
+            if not np.array_equal(want, got):
+                raise RuntimeError("pallas scan_blocks mismatch")
+            scan_ok = True
+        except Exception as e:  # noqa: BLE001 — XLA scan keeps working
+            field_mul_probe.setdefault("scan_error", repr(e))
+        if scan_ok:
+            use_scan = scan_pallas_ms < scan_xla_ms
+            msm_mod.set_pallas_scan(use_scan)
+            field_mul_probe.update(
+                scan_xla_ms=round(scan_xla_ms, 1),
+                scan_pallas_ms=round(scan_pallas_ms, 1),
+                scan_chosen="pallas" if use_scan else "xla",
+            )
+
         field_mul_probe.update(
             gemm_us=round(gemm_us, 1),
             pallas_us=round(pallas_us, 1),

@@ -401,3 +401,48 @@ def test_verify_resolved_chunked(monkeypatch):
     out = V.verify_batch_eq(items)
     assert len(out) == 150
     assert not out[100] and out.sum() == 149
+
+
+def test_pallas_scan_blocks_matches_xla_scan():
+    """The fused within-block prefix-scan kernel (interpret mode on CPU)
+    is limb-exact with the lax.scan of curve.add_cached it replaces."""
+
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tendermint_tpu.crypto.tpu import curve as C
+    from tendermint_tpu.crypto.tpu import msm as M
+    from tendermint_tpu.crypto.tpu import pallas_field as PF
+
+    rng = np.random.default_rng(17)
+    # 4-step blocks: the kernel is length-generic (production uses
+    # M._BLOCK=16); a short chain keeps interpret-mode tracing cheap
+    g, blk = 8, 4
+    coords = [rng.integers(0, 256, (g, blk, 32), dtype=np.int32) for _ in range(4)]
+    pts = C.Point(*(jnp.asarray(c) for c in coords))
+
+    first = C.Point(*(c[:, 0] for c in pts))
+    rest = C.Point(*(jnp.moveaxis(c[:, 1:], 1, 0) for c in pts))
+    rest_cached = C.to_cached(rest)
+
+    def xla_scan():
+        def step(acc, nxt):
+            acc = C.add_cached(acc, nxt)
+            return acc, acc
+
+        last, tail = __import__("jax").lax.scan(step, first, rest_cached)
+        within = C.Point(
+            *(
+                jnp.concatenate([f[:, None], jnp.moveaxis(t, 0, 1)], axis=1)
+                for f, t in zip(first, tail)
+            )
+        )
+        return within, last
+
+    want_within, want_last = xla_scan()
+    got = PF.scan_blocks(tuple(first), tuple(rest_cached), interpret=True, tile=8)
+    for w, gp in zip(want_within, got):
+        assert np.array_equal(np.asarray(w), np.asarray(gp))
+    for w, gp in zip(want_last, got):
+        assert np.array_equal(np.asarray(w), np.asarray(gp[:, -1]))
