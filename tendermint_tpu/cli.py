@@ -165,6 +165,8 @@ def _build_node(home: str):
         rpc_pprof=cfg.rpc.pprof,
         seed_mode=cfg.mode == "seed",
         addr_book_path=os.path.join(p["config"], "addrbook.json"),
+        watchdog_dir=os.path.join(p["data"], "debug") if cfg.rpc.watchdog else "",
+        watchdog_threshold_s=cfg.rpc.watchdog_threshold_s,
     )
     transport = TCPTransport(
         send_rate=cfg.p2p.send_rate, recv_rate=cfg.p2p.recv_rate

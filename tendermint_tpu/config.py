@@ -86,6 +86,12 @@ class RPCConfig:
     # serve /debug/pprof/* (reference pprof-laddr, config.go:529) —
     # opt-in: profiling slows the event loop
     pprof: bool = False
+    # event-loop liveness watchdog (libs/watchdog.py — the deadlock-
+    # detector analog, reference internal/libs/sync/deadlock.go): dump
+    # all stacks to <home>/data/debug when the loop wedges past the
+    # threshold. Opt-in.
+    watchdog: bool = False
+    watchdog_threshold_s: float = 5.0
 
 
 @dataclass

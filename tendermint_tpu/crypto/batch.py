@@ -92,8 +92,25 @@ def _probe_tpu() -> None:
             # set lands on (gb=255), not just the all-padding floor shape
             warmup(groups=150, fallback=True)
             _measure_cutoff()
+        # the TPU is usable as soon as the floor shapes are warm — flip
+        # availability BEFORE the optional big-bucket warm below, so
+        # normal consensus batches aren't CPU-routed for the minutes a
+        # cold 8192-shape compile can take
         _tpu_available = ok
         logger.info("TPU batch verifier %s", "ready" if ok else "unavailable")
+        if ok:
+            # pre-compile the block-sync range shape too (still on the
+            # background thread, both the batch-equation kernel and the
+            # bad-batch attribution fallback): the first historical-sync
+            # chunk otherwise stalls inline on a multi-minute XLA compile.
+            # Its failure must NOT revoke availability — the floor shapes
+            # are warm and perfectly usable.
+            from .tpu.verify import _MAX_BUCKET
+
+            try:
+                warmup(bucket=_MAX_BUCKET, groups=150, fallback=True)
+            except Exception as e:  # noqa: BLE001
+                logger.info("big-bucket warmup failed (non-fatal): %r", e)
     except Exception as e:
         logger.info("TPU batch verifier unavailable: %r", e)
         _tpu_available = False

@@ -367,15 +367,20 @@ def warmup(
     bad batches)."""
     g = groups or 1
     n = max(bucket or _MIN_BUCKET, _bucket(g))  # ≥1 signature per key
+    # warm the kernels production will SELECT for this size — on a
+    # multi-device host a big bucket routes to the sharded kernels, and
+    # warming the single-device jit would leave the real first batch to
+    # compile inline anyway
+    kernel_eq, kernel_sig, b = _select_kernels(n, 1)
     # distinct dummy keys pin the unique-key count; they need not
     # decompress (shape is what compiles), but must be format-valid
     entries: list[ResolvedSig | None] = [
         ResolvedSig(i.to_bytes(4, "little") + b"\x00" * 28, b"\x01" + b"\x00" * 31, 0, 0)
         for i in range(g)
     ] + [None] * (n - g)
-    _get_kernel_eq()(*prepare_batch_eq(entries, pad_to=n))
+    kernel_eq(*prepare_batch_eq(entries, pad_to=b))
     if fallback:
-        _get_kernel()(*prepare_resolved([None] * n, pad_to=n))
+        kernel_sig(*prepare_resolved([None] * n, pad_to=b))
 
 
 def make_sharded_kernel(mesh, axis: str = "data"):
