@@ -123,9 +123,13 @@ class Runner:
         cfg = config_from_toml(open(cfg_path).read())
         cfg.p2p.laddr = f"127.0.0.1:{p2p_port}"
         cfg.rpc.laddr = f"127.0.0.1:{p2p_port + 1}"
-        cfg.consensus.timeout_propose_ns = 1000 * MS
-        cfg.consensus.timeout_prevote_ns = 400 * MS
-        cfg.consensus.timeout_precommit_ns = 400 * MS
+        # generous timeouts on purpose: the CI host has ONE core shared
+        # by every node process plus pytest — tight propose windows make
+        # starved proposers miss their slot and the network churn rounds
+        # instead of progressing (observed as full-suite-only flakes)
+        cfg.consensus.timeout_propose_ns = 3000 * MS
+        cfg.consensus.timeout_prevote_ns = 1000 * MS
+        cfg.consensus.timeout_precommit_ns = 1000 * MS
         cfg.consensus.timeout_commit_ns = 300 * MS
         open(cfg_path, "w").write(config_to_toml(cfg))
 
