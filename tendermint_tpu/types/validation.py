@@ -210,7 +210,12 @@ def verify_commit_range(
     entry index) on failure."""
     if not entries:
         return
-    bv = crypto_batch.create_batch_verifier(entries[0][0].validators[0].pub_key)
+    # the verifier is created LAZILY, from the first batchable entry: a
+    # mixed ed25519+secp256k1 validator set routes every commit through
+    # the individual path below, and eagerly keying the verifier off
+    # validators[0] crashed whenever address ordering put a secp256k1
+    # key first (seen as a restarted node's block-sync dying mid-e2e)
+    bv = None
     added_any = False
     for ei, (vals, block_id, height, commit) in enumerate(entries):
         try:
@@ -219,6 +224,8 @@ def verify_commit_range(
                 # mixed/secp256k1 sets: verify this one individually
                 verify_commit_light(chain_id, vals, block_id, height, commit)
                 continue
+            if bv is None:
+                bv = crypto_batch.create_batch_verifier(vals.validators[0].pub_key)
             voting_power_needed = vals.total_voting_power() * 2 // 3
             tallied = 0
             for idx, cs, val in _iter_entries(vals, commit, lookup_by_index=True):

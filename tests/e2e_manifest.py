@@ -154,18 +154,27 @@ class Runner:
             PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
         home = self._home(spec)
-        self.procs[spec.name] = subprocess.Popen(
-            [
-                sys.executable,
-                "-c",
-                "from tendermint_tpu.cli import main; import sys; "
-                f"sys.exit(main(['--home', {home!r}, 'start']))",
-            ],
-            env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
-            start_new_session=True,
+        log_f = (
+            open(os.path.join(home, "node.log"), "ab")
+            if os.environ.get("E2E_KEEP_LOGS")
+            else None
         )
+        try:
+            self.procs[spec.name] = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    "from tendermint_tpu.cli import main; import sys; "
+                    f"sys.exit(main(['--home', {home!r}, 'start']))",
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=log_f if log_f is not None else subprocess.DEVNULL,
+                start_new_session=True,
+            )
+        finally:
+            if log_f is not None:
+                log_f.close()  # the child holds its own duplicated fd
 
     def rpc(self, name: str, path: str) -> dict:
         with urllib.request.urlopen(

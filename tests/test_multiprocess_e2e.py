@@ -87,9 +87,11 @@ def test_four_process_testnet_with_kill_restart(tmp_path):
         with open(toml_path) as f:
             cfg = config_from_toml(f.read())
         MS = 1_000_000
-        cfg.consensus.timeout_propose_ns = 1000 * MS
-        cfg.consensus.timeout_prevote_ns = 400 * MS
-        cfg.consensus.timeout_precommit_ns = 400 * MS
+        # generous windows: starved proposers on the 1-core CI host churn
+        # rounds under tight timeouts (same rationale as e2e_manifest.py)
+        cfg.consensus.timeout_propose_ns = 3000 * MS
+        cfg.consensus.timeout_prevote_ns = 1000 * MS
+        cfg.consensus.timeout_precommit_ns = 1000 * MS
         cfg.consensus.timeout_commit_ns = 300 * MS
         with open(toml_path, "w") as f:
             f.write(config_to_toml(cfg))

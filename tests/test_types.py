@@ -367,3 +367,39 @@ def test_consensus_params_roundtrip():
     p.validate_basic()
     assert ConsensusParams.decode(p.encode()) == p
     assert p.hash() == ConsensusParams.decode(p.encode()).hash()
+
+
+def test_verify_commit_range_mixed_set_secp_first():
+    """Regression: a mixed validator set whose highest-power (first-
+    sorted) validator is secp256k1 must still range-verify — the batch
+    verifier is created lazily from a BATCHABLE entry, not keyed off
+    validators[0] (which crashed block-sync on restarted mixed-key
+    nodes whenever address order put the secp key first)."""
+    import hashlib
+
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.crypto.secp256k1 import Secp256k1PrivKey
+    from tendermint_tpu.testing import make_block_id, make_commit
+    from tendermint_tpu.types.validation import verify_commit_range
+    from tendermint_tpu.types.validator_set import Validator, ValidatorSet
+
+    secp = Secp256k1PrivKey(hashlib.sha256(b"mixed-first").digest())
+    eds = [
+        ed25519.Ed25519PrivKey(hashlib.sha256(b"mixed-%d" % i).digest())
+        for i in range(3)
+    ]
+    # secp gets the highest power -> guaranteed validators[0] after the
+    # (-power, address) sort
+    vals = ValidatorSet(
+        [Validator(secp.pub_key(), 100)]
+        + [Validator(k.pub_key(), 10) for k in eds]
+    )
+    assert vals.validators[0].pub_key.TYPE == "secp256k1"
+    keys = {k.pub_key().address(): k for k in [secp] + eds}
+
+    entries = []
+    for h in (1, 2):
+        bid = make_block_id(b"mixed-range-%d" % h)
+        commit = make_commit("mixed-range", h, 0, bid, vals, keys)
+        entries.append((vals, bid, h, commit))
+    verify_commit_range("mixed-range", entries)  # must not raise
