@@ -5,7 +5,15 @@ up to 600 in-flight requesters, ≤20 per peer).
 `next_requests()` yields (height, peer) assignments; the reactor sends
 BlockRequests and feeds responses back via `add_block`. `peek_range`
 returns the contiguous run of downloaded blocks starting at `height` —
-the unit the reactor feeds to the range-batched verifier."""
+the unit the reactor feeds to the range-batched verifier.
+
+Resilience: request timeouts are ADAPTIVE per peer — a Jacobson/Karels
+RTO (srtt + 4·rttvar, clamped) learned from observed block-response
+RTTs, so a fast in-memory peer is re-tried in milliseconds while a slow
+WAN peer isn't spuriously timed out. Repeated consecutive timeouts ban
+the peer (the reactor drains `take_banned()` and reports a fatal
+PeerError) instead of the old single-counter bookkeeping that never
+acted on anything."""
 
 from __future__ import annotations
 
@@ -17,7 +25,11 @@ from ..types.block import Block
 
 REQUEST_WINDOW = 128  # in-flight heights (reference: 600)
 PER_PEER_LIMIT = 16  # reference maxPendingRequestsPerPeer=20
-REQUEST_TIMEOUT = 15.0
+REQUEST_TIMEOUT = 15.0  # RTO ceiling (the old fixed timeout)
+INITIAL_REQUEST_TIMEOUT = 2.0  # cold-start RTO before any RTT sample (à la TCP)
+MIN_REQUEST_TIMEOUT = 0.25  # RTO floor: don't hammer sub-ms in-memory links
+BAN_AFTER_TIMEOUTS = 5  # consecutive timeouts before a peer is banned
+BAN_COOLDOWN = 30.0  # quarantine; after this the peer may re-register
 
 
 @dataclass
@@ -26,7 +38,40 @@ class _Peer:
     base: int = 0
     height: int = 0
     pending: set[int] = field(default_factory=set)
-    timeouts: int = 0
+    timeouts: int = 0  # consecutive request timeouts (reset by any block)
+    total_timeouts: int = 0
+    blocks_served: int = 0
+    srtt: float = 0.0  # smoothed RTT, 0 = no sample yet
+    rttvar: float = 0.0
+
+    def observe_rtt(self, rtt: float) -> None:
+        """Jacobson/Karels (RFC 6298 §2) smoothing."""
+        if self.srtt == 0.0:
+            self.srtt = rtt
+            self.rttvar = rtt / 2
+        else:
+            self.rttvar = 0.75 * self.rttvar + 0.25 * abs(self.srtt - rtt)
+            self.srtt = 0.875 * self.srtt + 0.125 * rtt
+        self.timeouts = 0
+        self.blocks_served += 1
+
+    def request_timeout(self) -> float:
+        """Adaptive RTO; doubles per consecutive timeout (classic RTO
+        backoff) so a degraded peer is probed, not flooded."""
+        if self.srtt == 0.0:
+            rto = INITIAL_REQUEST_TIMEOUT
+        else:
+            rto = min(
+                max(self.srtt + 4 * self.rttvar, MIN_REQUEST_TIMEOUT),
+                REQUEST_TIMEOUT,
+            )
+        return min(rto * (2**self.timeouts), REQUEST_TIMEOUT)
+
+    def health(self) -> float:
+        """Scheduling score, lower = better: load + timeout penalty +
+        latency. Drives _pick_peer away from degraded peers before the
+        ban threshold is reached."""
+        return len(self.pending) + 4.0 * self.timeouts + self.srtt
 
 
 @dataclass
@@ -49,10 +94,17 @@ class BlockPool:
         # grace measures from here, not from pool start, so a transient
         # total peer loss mid-sync doesn't instantly report caught-up
         self._no_peers_since = time.monotonic()
+        self._banned: list[str] = []  # drained by the reactor (take_banned)
+        # quarantine expiry per banned peer: a TIMED ban, not a permanent
+        # one — transient total-loss events (a partition) must not strand
+        # the node with an empty peer set after the net heals
+        self._ban_until: dict[str, float] = {}
 
     # -- peers -----------------------------------------------------------
 
     def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
+        if time.monotonic() < self._ban_until.get(peer_id, 0.0):
+            return
         p = self.peers.setdefault(peer_id, _Peer(peer_id))
         p.base, p.height = base, height
 
@@ -70,6 +122,21 @@ class BlockPool:
                 redo.append(h)
         return redo
 
+    def take_banned(self) -> list[str]:
+        """Peers banned since the last call (for the reactor to report)."""
+        out, self._banned = self._banned, []
+        return out
+
+    def _ban(self, peer: _Peer) -> None:
+        self.logger.info(
+            "banning peer %s after %d consecutive request timeouts",
+            peer.peer_id[:12],
+            peer.timeouts,
+        )
+        self._ban_until[peer.peer_id] = time.monotonic() + BAN_COOLDOWN
+        self._banned.append(peer.peer_id)
+        self.remove_peer(peer.peer_id)
+
     def max_peer_height(self) -> int:
         return max((p.height for p in self.peers.values()), default=0)
 
@@ -80,14 +147,20 @@ class BlockPool:
         capacity (reference makeNextRequests pool.go:394)."""
         out = []
         now = time.monotonic()
-        # retry timed-out requests first
+        # retry timed-out requests first (per-peer adaptive RTO)
         for h, req in list(self.requests.items()):
-            if now - req.time > REQUEST_TIMEOUT and h not in self.blocks:
-                p = self.peers.get(req.peer_id)
+            if h in self.blocks:
+                continue
+            p = self.peers.get(req.peer_id)
+            timeout = p.request_timeout() if p is not None else REQUEST_TIMEOUT
+            if now - req.time > timeout:
                 if p is not None:
                     p.pending.discard(h)
                     p.timeouts += 1
-                del self.requests[h]
+                    p.total_timeouts += 1
+                    if p.timeouts >= BAN_AFTER_TIMEOUTS:
+                        self._ban(p)  # also clears the peer's requests
+                self.requests.pop(h, None)
         for h in range(self.height, self.height + REQUEST_WINDOW):
             if h in self.blocks or h in self.requests:
                 continue
@@ -106,7 +179,7 @@ class BlockPool:
                 continue
             if len(p.pending) >= PER_PEER_LIMIT:
                 continue
-            if best is None or len(p.pending) < len(best.pending):
+            if best is None or p.health() < best.health():
                 best = p
         return best
 
@@ -129,6 +202,8 @@ class BlockPool:
             assigned = self.peers.get(req.peer_id)
             if assigned is not None:
                 assigned.pending.discard(h)
+                if req.peer_id == peer_id:
+                    assigned.observe_rtt(time.monotonic() - req.time)
             del self.requests[h]
         return True
 

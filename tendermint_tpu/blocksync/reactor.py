@@ -61,12 +61,24 @@ class BlockSyncReactor(Service):
         self.active = active
         self.pool = BlockPool(state.last_block_height + 1)
         self.synced = asyncio.Event()  # set on caught-up (switch to consensus)
-        self.metrics = {"blocks_applied": 0, "sigs_verified": 0, "ranges": 0}
-        # commits ≤ this height are signature-proven by a range batch (or
-        # the sequential fallback) against the validator set whose hash is
-        # recorded alongside; lets apply_block skip the redundant host
-        # re-verification of each block's LastCommit. Reset on redo(): a
-        # re-fetched block can carry a different commit.
+        self.metrics = {
+            "blocks_applied": 0,
+            "sigs_verified": 0,
+            "ranges": 0,
+            "peer_bans": 0,
+        }
+        # Commits for heights in [_commit_verified_from, _commit_verified_upto]
+        # are signature-proven by a range batch (or the sequential fallback)
+        # against the validator set whose hash is recorded alongside; lets
+        # apply_block skip the redundant host re-verification of each block's
+        # LastCommit. NOTE the lower bound: a range starting at height h
+        # proves the commits FOR h..upto (block h+1's LastCommit is the
+        # commit for h) — it proves nothing about the commit for h-1, so the
+        # first block applied after startup/resume must be full-verified
+        # (commit_verified=False). Reset on redo(): a re-fetched block can
+        # carry a different commit; reset on resume(): the proof interval is
+        # stale after a consensus interlude.
+        self._commit_verified_from = None  # no proof interval yet
         self._commit_verified_upto = 0
         self._commit_verified_vals = b""
 
@@ -89,6 +101,9 @@ class BlockSyncReactor(Service):
         self.pool.blocks = {
             h: b for h, b in self.pool.blocks.items() if h > state.last_block_height
         }
+        self._commit_verified_from = None
+        self._commit_verified_upto = 0
+        self._commit_verified_vals = b""
         self.synced = asyncio.Event()
         self.spawn(self._request_routine(), name="bsr.req")
         self.spawn(self._sync_routine(), name="bsr.sync")
@@ -145,6 +160,13 @@ class BlockSyncReactor(Service):
         while not self.synced.is_set():
             for height, peer_id in self.pool.next_requests():
                 self._send(m.BlockRequest(height), to=peer_id)
+            # peers the pool banned for repeated consecutive timeouts are
+            # evicted for real (fatal PeerError -> router disconnect)
+            for pid in self.pool.take_banned():
+                self.metrics["peer_bans"] += 1
+                await self.channel.error(
+                    PeerError(pid, "blocksync: repeated request timeouts")
+                )
             await asyncio.sleep(REQUEST_INTERVAL)
 
     async def _status_routine(self) -> None:
@@ -199,8 +221,11 @@ class BlockSyncReactor(Service):
             dt = time.monotonic() - t0
             self.metrics["ranges"] += 1
             self.metrics["sigs_verified"] += n_sigs
-            self._commit_verified_upto = first_height + len(entries) - 1
-            self._commit_verified_vals = assumed_vals.hash()
+            # the batch proved the commits FOR first_height..first+len-1
+            # (each block's successor LastCommit), all against assumed_vals
+            self._record_commit_proof(
+                first_height, first_height + len(entries) - 1, assumed_vals.hash()
+            )
             self.logger.debug(
                 "verified range h=%d..%d (%d sigs) in %.1fms",
                 first_height,
@@ -248,8 +273,9 @@ class BlockSyncReactor(Service):
                 # record the re-proof so the NEXT block's apply doesn't
                 # redo this commit on the host (same bookkeeping as the
                 # sequential fallback)
-                self._commit_verified_upto = max(self._commit_verified_upto, height)
-                self._commit_verified_vals = self.state.validators.hash()
+                self._record_commit_proof(
+                    height, height, self.state.validators.hash()
+                )
             if not await self._apply_one(block, block_id, parts, next_block, provider):
                 return
         return
@@ -282,8 +308,7 @@ class BlockSyncReactor(Service):
             # commit for `height` proven against the TRUE set for that
             # height (state.validators now == state.last_validators when
             # block height+1 is applied next iteration)
-            self._commit_verified_upto = max(self._commit_verified_upto, height)
-            self._commit_verified_vals = self.state.validators.hash()
+            self._record_commit_proof(height, height, self.state.validators.hash())
             if not await self._apply_one(block, block_id, parts, next_block, provider):
                 return
 
@@ -300,13 +325,39 @@ class BlockSyncReactor(Service):
         self.pool.redo(height, provider, next_provider)
         self._commit_verified_upto = min(self._commit_verified_upto, height - 1)
 
+    def _record_commit_proof(self, a: int, b: int, vals_hash: bytes) -> None:
+        """Merge a freshly proven commit interval [a, b] (commits FOR
+        those heights, proven against vals_hash). A proof under a
+        different validator-set hash, or one not contiguous with the
+        recorded interval, REPLACES it — extending across a gap or a set
+        change would claim proofs that were never computed."""
+        lo, hi = self._commit_verified_from, self._commit_verified_upto
+        if (
+            lo is None
+            or vals_hash != self._commit_verified_vals
+            or hi < lo  # emptied by a redo/punish rollback
+            or a > hi + 1  # gap above
+            or b < lo - 1  # gap below
+        ):
+            self._commit_verified_from, self._commit_verified_upto = a, b
+            self._commit_verified_vals = vals_hash
+        else:
+            self._commit_verified_from = min(lo, a)
+            self._commit_verified_upto = max(hi, b)
+
     def _commit_preverified(self, height: int) -> bool:
         """True when block `height`'s LastCommit (the commit for
         height-1) was already signature-proven by a batch/sequential
         verification against exactly the set validate_block will check
-        it with (state.last_validators)."""
+        it with (state.last_validators).
+
+        The lower bound matters: the first range proves commits from its
+        OWN first height onward, never the commit for first_height-1, so
+        the first block applied after startup/resume always takes the
+        full apply-time verification path (commit_verified=False)."""
         return (
-            height - 1 <= self._commit_verified_upto
+            self._commit_verified_from is not None
+            and self._commit_verified_from <= height - 1 <= self._commit_verified_upto
             and self.state.last_validators.hash() == self._commit_verified_vals
         )
 

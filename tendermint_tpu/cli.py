@@ -167,6 +167,7 @@ def _build_node(home: str):
         addr_book_path=os.path.join(p["config"], "addrbook.json"),
         watchdog_dir=os.path.join(p["data"], "debug") if cfg.rpc.watchdog else "",
         watchdog_threshold_s=cfg.rpc.watchdog_threshold_s,
+        chaos=cfg.chaos,
     )
     transport = TCPTransport(
         send_rate=cfg.p2p.send_rate, recv_rate=cfg.p2p.recv_rate

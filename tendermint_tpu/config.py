@@ -95,6 +95,23 @@ class RPCConfig:
 
 
 @dataclass
+class ChaosNetConfig:
+    """Chaos-net fault injection (libs/chaos.py). Off by default; when
+    `enabled`, every transport the node constructs is wrapped in the
+    seeded fault-injection layer. The same knobs are reachable without a
+    config file through TMTPU_CHAOS_* env vars (libs/chaos.py docstring);
+    a fixed seed makes a fault schedule reproducible."""
+
+    enabled: bool = False
+    seed: int = 0
+    drop_rate: float = 0.0  # per-message drop probability
+    delay_ms: float = 0.0  # p50 extra latency (exponential tail)
+    duplicate_rate: float = 0.0
+    reorder_rate: float = 0.0
+    corrupt_rate: float = 0.0
+
+
+@dataclass
 class StateSyncConfig:
     """Reference config statesync section."""
 
@@ -125,6 +142,7 @@ class Config:
     rpc: RPCConfig = field(default_factory=RPCConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    chaos: ChaosNetConfig = field(default_factory=ChaosNetConfig)
 
 
 def _section_to_toml(name: str, obj) -> str:
@@ -157,12 +175,17 @@ def config_to_toml(cfg: Config) -> str:
         "",
         _section_to_toml("blocksync", cfg.blocksync),
         "",
+        _section_to_toml("chaos", cfg.chaos),
+        "",
     ]
     return "\n".join(parts)
 
 
 def config_from_toml(text: str) -> Config:
-    import tomllib
+    try:
+        import tomllib  # stdlib from 3.11
+    except ModuleNotFoundError:  # 3.10 images: tomli is the same parser
+        import tomli as tomllib
 
     data = tomllib.loads(text)
     cfg = Config()
@@ -175,6 +198,7 @@ def config_from_toml(text: str) -> Config:
         ("rpc", cfg.rpc),
         ("statesync", cfg.statesync),
         ("blocksync", cfg.blocksync),
+        ("chaos", cfg.chaos),
     ):
         for k, v in data.get(section, {}).items():
             if hasattr(obj, k):

@@ -12,6 +12,8 @@ import logging
 import os
 import threading
 
+from ..libs.metrics import record_resilience
+from ..libs.retry import CircuitBreaker
 from . import BatchVerifier, PubKey
 from .ed25519 import KEY_TYPE as ED25519
 from .sr25519 import KEY_TYPE as SR25519
@@ -195,11 +197,36 @@ def tpu_verifier_available(*, blocking: bool = False) -> bool:
 MIN_TPU_BATCH = int(os.environ.get("TMTPU_MIN_TPU_BATCH", "32"))
 
 
+# TPU-path circuit breaker: any backend/kernel error mid-batch trips it
+# (the batch transparently re-verifies on the CPU — results are identical,
+# only slower), routing stays on the host while it is open, and a
+# half-open probe periodically re-tries the device. One failure is enough
+# to trip: a crashed backend keeps failing, and 30 s of host routing is
+# cheap next to a stalled sync pipeline. Env overrides for ops/tests.
+_tpu_breaker = CircuitBreaker(
+    failure_threshold=int(os.environ.get("TMTPU_TPU_BREAKER_THRESHOLD", "1")),
+    reset_timeout=float(os.environ.get("TMTPU_TPU_BREAKER_RESET", "30")),
+    name="tpu-batch-verify",
+)
+
+
+def tpu_breaker() -> CircuitBreaker:
+    """The process-wide TPU-path breaker (exposed for tests/ops)."""
+    return _tpu_breaker
+
+
 class AdaptiveBatchVerifier(BatchVerifier):
     """Collects entries, then routes the whole batch to the TPU kernel if
     it is large enough (and a backend is usable), else verifies on the
     host. Small commits therefore never pay a device round-trip or a
-    first-call compile."""
+    first-call compile.
+
+    Degradation: a TPU failure mid-batch (backend crash, kernel error)
+    re-verifies the SAME batch on the CPU path — the caller sees the
+    identical (ok, per-signature) result, never the error — trips the
+    TPU circuit breaker, and records the event in libs/metrics. While the
+    breaker is open all batches route to the host; its half-open probe
+    sends one batch back to the device to test recovery."""
 
     def __init__(self):
         self._items: list[tuple[PubKey, bytes, bytes]] = []
@@ -214,11 +241,38 @@ class AdaptiveBatchVerifier(BatchVerifier):
 
     def verify(self) -> tuple[bool, list[bool]]:
         if len(self._items) >= MIN_TPU_BATCH and tpu_verifier_available():
-            from .tpu.verify import TPUBatchVerifier
+            probing = _tpu_breaker.state != "closed"  # read before allow() claims
+            if _tpu_breaker.allow():
+                if probing:
+                    record_resilience("tpu_breaker_probes")
+                    logger.info("TPU breaker half-open: probing the device path")
+                try:
+                    out = self._run(self._make_tpu_verifier())
+                except Exception as e:  # noqa: BLE001 — any device error degrades
+                    opens_before = _tpu_breaker.opens
+                    _tpu_breaker.record_failure()
+                    record_resilience("tpu_fallback_batches")
+                    record_resilience("tpu_fallback_sigs", len(self._items))
+                    if _tpu_breaker.opens > opens_before:
+                        record_resilience("tpu_breaker_opens")
+                    logger.warning(
+                        "TPU batch verification failed (%r); re-verifying "
+                        "%d signatures on CPU (breaker %s)",
+                        e,
+                        len(self._items),
+                        _tpu_breaker.state,
+                    )
+                else:
+                    _tpu_breaker.record_success()
+                    return out
+        return self._run(CPUBatchVerifier())
 
-            target = TPUBatchVerifier()
-        else:
-            target = CPUBatchVerifier()
+    def _make_tpu_verifier(self) -> BatchVerifier:
+        from .tpu.verify import TPUBatchVerifier
+
+        return TPUBatchVerifier()
+
+    def _run(self, target: BatchVerifier) -> tuple[bool, list[bool]]:
         for pk, msg, sig in self._items:
             target.add(pk, msg, sig)
         return target.verify()

@@ -1,28 +1,39 @@
 """Ed25519 key types (analog of reference crypto/ed25519/ed25519.go).
 
 Signing and the fast-path verification use the OpenSSL-backed `cryptography`
-package; consensus-facing verification follows ZIP-215 semantics (reference
-crypto/ed25519/ed25519.go:26-28): OpenSSL's (cofactorless, canonical-only)
-accept set is a strict subset of ZIP-215's, so an OpenSSL accept is final and
-an OpenSSL reject falls back to the pure-Python cofactored verifier in
-ed25519_math.py. Batch verification is dispatched through crypto/batch.py and
-runs on TPU when available (crypto/tpu/)."""
+package when it is importable; consensus-facing verification follows ZIP-215
+semantics (reference crypto/ed25519/ed25519.go:26-28): OpenSSL's
+(cofactorless, canonical-only) accept set is a strict subset of ZIP-215's, so
+an OpenSSL accept is final and an OpenSSL reject falls back to the pure-Python
+cofactored verifier in ed25519_math.py.
+
+On images without `cryptography` the module degrades to the pure-Python
+RFC 8032 implementation in ed25519_math.py for BOTH signing and verification
+(same deterministic signatures, same ZIP-215 accept set — ed25519_math is the
+correctness oracle the OpenSSL path is tested against). Batch verification is
+dispatched through crypto/batch.py and runs on TPU when available
+(crypto/tpu/)."""
 
 from __future__ import annotations
 
 import secrets
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    NoEncryption,
-    PrivateFormat,
-    PublicFormat,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        NoEncryption,
+        PrivateFormat,
+        PublicFormat,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # degraded path: pure-Python RFC 8032 (ed25519_math)
+    _HAVE_OPENSSL = False
 
 from . import PrivKey, PubKey, register_pubkey_type
 from . import ed25519_math
@@ -31,6 +42,13 @@ KEY_TYPE = "ed25519"
 PUBKEY_SIZE = 32
 PRIVKEY_SIZE = 32  # seed
 SIGNATURE_SIZE = 64
+
+# Degraded-path verification memo: verification is a pure function of
+# (pubkey, msg, sig), and gossip protocols verify the SAME votes/commit
+# sigs once per receiving node in-process — at pure-Python speeds that
+# dedup is worth holding on to. Only consulted when OpenSSL is absent.
+_VERIFY_MEMO: dict[tuple[bytes, bytes, bytes], bool] = {}
+_VERIFY_MEMO_MAX = 100_000
 
 
 class Ed25519PubKey(PubKey):
@@ -47,13 +65,23 @@ class Ed25519PubKey(PubKey):
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
         if len(sig) != SIGNATURE_SIZE:
             return False
-        try:
-            Ed25519PublicKey.from_public_bytes(self._bytes).verify(sig, msg)
-            return True
-        except (InvalidSignature, ValueError):
-            # OpenSSL rejects some ZIP-215-valid signatures (non-canonical R/A
-            # encodings, mixed-order points); re-check cofactored.
-            return ed25519_math.verify_zip215(self._bytes, msg, sig)
+        if _HAVE_OPENSSL:
+            try:
+                Ed25519PublicKey.from_public_bytes(self._bytes).verify(sig, msg)
+                return True
+            except (InvalidSignature, ValueError):
+                # OpenSSL rejects some ZIP-215-valid signatures (non-canonical
+                # R/A encodings, mixed-order points); re-check cofactored.
+                return ed25519_math.verify_zip215(self._bytes, msg, sig)
+        key = (self._bytes, bytes(msg), bytes(sig))
+        hit = _VERIFY_MEMO.get(key)
+        if hit is not None:
+            return hit
+        ok = ed25519_math.verify_zip215(self._bytes, msg, sig)
+        if len(_VERIFY_MEMO) >= _VERIFY_MEMO_MAX:
+            _VERIFY_MEMO.clear()
+        _VERIFY_MEMO[key] = ok
+        return ok
 
 
 class Ed25519PrivKey(PrivKey):
@@ -63,10 +91,14 @@ class Ed25519PrivKey(PrivKey):
         if len(seed) != PRIVKEY_SIZE:
             raise ValueError(f"ed25519 privkey seed must be {PRIVKEY_SIZE} bytes")
         self._seed = bytes(seed)
-        self._sk = Ed25519PrivateKey.from_private_bytes(self._seed)
-        self._pub = self._sk.public_key().public_bytes(
-            Encoding.Raw, PublicFormat.Raw
-        )
+        if _HAVE_OPENSSL:
+            self._sk = Ed25519PrivateKey.from_private_bytes(self._seed)
+            self._pub = self._sk.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw
+            )
+        else:
+            self._sk = None
+            self._pub = ed25519_math.public_from_seed(self._seed)
 
     @classmethod
     def generate(cls) -> "Ed25519PrivKey":
@@ -76,7 +108,9 @@ class Ed25519PrivKey(PrivKey):
         return self._seed
 
     def sign(self, msg: bytes) -> bytes:
-        return self._sk.sign(msg)
+        if self._sk is not None:
+            return self._sk.sign(msg)
+        return ed25519_math.sign(self._seed, msg)
 
     def pub_key(self) -> Ed25519PubKey:
         return Ed25519PubKey(self._pub)

@@ -110,13 +110,25 @@ class Point:
         return Point((-self.X) % P, self.Y, self.Z, (-self.T) % P)
 
     def scalar_mul(self, k: int) -> "Point":
-        q = Point.identity()
-        base = self
+        """Fixed-window (4-bit) scalar multiplication: ~63 doubling
+        rounds + ≤15 precompute adds + ~60 window adds — ~30% fewer
+        point operations than the binary ladder, which matters when this
+        module is the production fallback (no OpenSSL) rather than just
+        the oracle."""
+        if k == 0:
+            return Point.identity()
+        tbl = [Point.identity(), self]
+        for _ in range(14):
+            tbl.append(tbl[-1].add(self))
+        digits = []
         while k:
-            if k & 1:
-                q = q.add(base)
-            base = base.double()
-            k >>= 1
+            digits.append(k & 0xF)
+            k >>= 4
+        q = Point.identity()
+        for d in reversed(digits):
+            q = q.double().double().double().double()
+            if d:
+                q = q.add(tbl[d])
         return q
 
     def mul_by_cofactor(self) -> "Point":
@@ -135,10 +147,60 @@ class Point:
 
 BASE = Point.from_affine(BX, _BY)
 
+# Precomputed base-point table for the fixed-base multiplications that
+# dominate signing and the s·B half of verification: _BASE_TABLE[i][d] =
+# d·16^i·B, so k·B is ~64 pure additions with zero doublings. Built
+# lazily (~1k point adds) the first time the degraded-signing path runs.
+_BASE_TABLE: list | None = None
+
+
+def _base_table() -> list:
+    global _BASE_TABLE
+    if _BASE_TABLE is None:
+        tbl = []
+        base = BASE
+        for _ in range(64):
+            row = [Point.identity()]
+            for _d in range(15):
+                row.append(row[-1].add(base))
+            tbl.append(row)
+            base = row[8].double()  # 16·base for the next window
+        _BASE_TABLE = tbl
+    return _BASE_TABLE
+
+
+def scalar_mul_base(k: int) -> Point:
+    """k·B via the fixed-base table (k reduced mod L by callers)."""
+    tbl = _base_table()
+    q = Point.identity()
+    i = 0
+    while k:
+        d = k & 0xF
+        if d:
+            q = q.add(tbl[i][d])
+        k >>= 4
+        i += 1
+    return q
+
 
 def scalar_from_hash(r_bytes: bytes, a_bytes: bytes, msg: bytes) -> int:
     h = hashlib.sha512(r_bytes + a_bytes + msg).digest()
     return int.from_bytes(h, "little") % L
+
+
+# decompressed-pubkey cache: consensus verifies the same validator keys
+# over and over; decompression costs two field exponentiations
+_A_CACHE: dict[bytes, "Point | None"] = {}
+
+
+def _decompress_pubkey(pubkey: bytes) -> "Point | None":
+    if pubkey in _A_CACHE:
+        return _A_CACHE[pubkey]
+    pt = Point.decompress(pubkey)
+    if len(_A_CACHE) > 4096:
+        _A_CACHE.clear()
+    _A_CACHE[pubkey] = pt
+    return pt
 
 
 def verify_zip215(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
@@ -149,13 +211,13 @@ def verify_zip215(pubkey: bytes, msg: bytes, sig: bytes) -> bool:
     s = int.from_bytes(s_bytes, "little")
     if s >= L:
         return False
-    A = Point.decompress(pubkey)
+    A = _decompress_pubkey(pubkey)
     R = Point.decompress(r_bytes)
     if A is None or R is None:
         return False
     k = scalar_from_hash(r_bytes, pubkey, msg)
     # [8][s]B == [8]R + [8][k]A
-    lhs = BASE.scalar_mul(s).mul_by_cofactor()
+    lhs = scalar_mul_base(s).mul_by_cofactor()
     rhs = R.add(A.scalar_mul(k)).mul_by_cofactor()
     return lhs.equals(rhs)
 
@@ -168,10 +230,10 @@ def sign(privkey_seed: bytes, msg: bytes) -> bytes:
     a &= (1 << 254) - 8
     a |= 1 << 254
     prefix = h[32:]
-    A = BASE.scalar_mul(a)
+    A = scalar_mul_base(a)
     a_bytes = A.compress()
     r = int.from_bytes(hashlib.sha512(prefix + msg).digest(), "little") % L
-    R = BASE.scalar_mul(r)
+    R = scalar_mul_base(r)
     r_bytes = R.compress()
     k = scalar_from_hash(r_bytes, a_bytes, msg)
     s = (r + k * a) % L
@@ -183,4 +245,4 @@ def public_from_seed(privkey_seed: bytes) -> bytes:
     a = int.from_bytes(h[:32], "little")
     a &= (1 << 254) - 8
     a |= 1 << 254
-    return BASE.scalar_mul(a).compress()
+    return scalar_mul_base(a).compress()

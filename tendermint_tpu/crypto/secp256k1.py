@@ -5,26 +5,36 @@ low-S malleability rule enforced on both sign and verify, matching the
 reference (crypto/secp256k1/secp256k1_nocgo.go:21-48). Public keys are
 33-byte compressed SEC1. Like the reference, secp256k1 has no batch verifier
 in round 1 — commits fall back to single verification (the TPU ECDSA-recover
-kernel is a later milestone, see BASELINE.md config 4)."""
+kernel is a later milestone, see BASELINE.md config 4).
+
+When the OpenSSL-backed `cryptography` package is absent the module degrades
+to the pure-Python RFC 6979 implementation in softcrypto.py (deterministic
+nonces on both paths, so signatures are stable either way)."""
 
 from __future__ import annotations
 
 import secrets
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes as crypto_hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes as crypto_hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+
+    _HAVE_OPENSSL = True
+except ImportError:  # degraded path: pure-Python ECDSA (softcrypto)
+    _HAVE_OPENSSL = False
 
 from . import PrivKey, PubKey, register_pubkey_type
+from . import softcrypto
 from .hashes import sha256
 
 KEY_TYPE = "secp256k1"
@@ -55,6 +65,8 @@ class Secp256k1PubKey(PubKey):
         s = int.from_bytes(sig[32:], "big")
         if not (0 < r < N and 0 < s <= HALF_N):  # reject high-S (malleability)
             return False
+        if not _HAVE_OPENSSL:
+            return softcrypto.secp256k1_verify(self._bytes, sha256(msg), r, s)
         try:
             pub = ec.EllipticCurvePublicKey.from_encoded_point(
                 ec.SECP256K1(), self._bytes
@@ -76,12 +88,15 @@ class Secp256k1PrivKey(PrivKey):
         if len(data) != PRIVKEY_SIZE:
             raise ValueError(f"secp256k1 privkey must be {PRIVKEY_SIZE} bytes")
         self._bytes = bytes(data)
-        self._sk = ec.derive_private_key(
-            int.from_bytes(data, "big"), ec.SECP256K1()
-        )
-        self._pub = self._sk.public_key().public_bytes(
-            Encoding.X962, PublicFormat.CompressedPoint
-        )
+        self._d = int.from_bytes(data, "big")
+        if _HAVE_OPENSSL:
+            self._sk = ec.derive_private_key(self._d, ec.SECP256K1())
+            self._pub = self._sk.public_key().public_bytes(
+                Encoding.X962, PublicFormat.CompressedPoint
+            )
+        else:
+            self._sk = None
+            self._pub = softcrypto.secp256k1_pub(self._d)
 
     @classmethod
     def generate(cls) -> "Secp256k1PrivKey":
@@ -95,10 +110,13 @@ class Secp256k1PrivKey(PrivKey):
         return self._bytes
 
     def sign(self, msg: bytes) -> bytes:
-        der = self._sk.sign(
-            sha256(msg), ec.ECDSA(Prehashed(crypto_hashes.SHA256()))
-        )
-        r, s = decode_dss_signature(der)
+        if self._sk is not None:
+            der = self._sk.sign(
+                sha256(msg), ec.ECDSA(Prehashed(crypto_hashes.SHA256()))
+            )
+            r, s = decode_dss_signature(der)
+        else:
+            r, s = softcrypto.secp256k1_sign(self._d, sha256(msg))
         if s > HALF_N:
             s = N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")

@@ -16,7 +16,13 @@ from __future__ import annotations
 
 import struct
 
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+try:
+    from cryptography.exceptions import InvalidTag
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+except ImportError:  # degraded path: pure-Python RFC 8439 (softcrypto)
+    from .softcrypto import ChaCha20Poly1305, InvalidTag
+
+__all__ = ["XChaCha20Poly1305", "hchacha20", "InvalidTag"]
 
 KEY_SIZE = 32
 NONCE_SIZE = 24
@@ -80,6 +86,6 @@ class XChaCha20Poly1305:
         return aead.encrypt(n12, plaintext, aad or None)
 
     def open(self, nonce: bytes, ciphertext: bytes, aad: bytes = b"") -> bytes:
-        """Raises cryptography.exceptions.InvalidTag on forgery."""
+        """Raises InvalidTag (re-exported from this module) on forgery."""
         aead, n12 = self._inner(nonce)
         return aead.decrypt(n12, ciphertext, aad or None)

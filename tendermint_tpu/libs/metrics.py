@@ -87,6 +87,24 @@ class Histogram:
         return out
 
 
+# -- process-wide resilience events -----------------------------------------
+#
+# Crypto backends (crypto/batch.py) are process-wide singletons, not per-node
+# objects, so their degradation events land in this module-level store;
+# NodeMetrics folds them into its Prometheus output at render time.
+
+RESILIENCE: dict[str, float] = {
+    "tpu_fallback_batches": 0.0,  # batches re-verified on CPU after a TPU error
+    "tpu_fallback_sigs": 0.0,  # signatures in those batches
+    "tpu_breaker_opens": 0.0,  # TPU circuit-breaker trips
+    "tpu_breaker_probes": 0.0,  # half-open probes sent back to the TPU
+}
+
+
+def record_resilience(name: str, value: float = 1.0) -> None:
+    RESILIENCE[name] = RESILIENCE.get(name, 0.0) + value
+
+
 def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
@@ -154,12 +172,34 @@ class NodeMetrics:
         self.blocksync_sigs = r.counter(
             "blocksync", "sigs_verified", "signatures batch-verified"
         )
+        self.blocksync_bans = r.counter(
+            "blocksync", "peer_bans", "peers banned for repeated request timeouts"
+        )
+        # resilience (crypto backend degradation, process-wide)
+        self.crypto_tpu_fallbacks = r.counter(
+            "crypto", "tpu_fallback_batches",
+            "batches transparently re-verified on CPU after a TPU failure",
+        )
+        self.crypto_tpu_fallback_sigs = r.counter(
+            "crypto", "tpu_fallback_sigs", "signatures CPU-re-verified on fallback"
+        )
+        self.crypto_breaker_opens = r.counter(
+            "crypto", "tpu_breaker_opens", "TPU circuit-breaker trips"
+        )
+        self.crypto_breaker_probes = r.counter(
+            "crypto", "tpu_breaker_probes", "half-open probes routed back to TPU"
+        )
         # abci
         self.abci_latency = r.histogram(
             "abci", "connection_latency_seconds", "app call latency"
         )
 
     def render(self) -> str:
+        # fold the process-wide resilience events in at scrape time
+        self.crypto_tpu_fallbacks._values[()] = RESILIENCE["tpu_fallback_batches"]
+        self.crypto_tpu_fallback_sigs._values[()] = RESILIENCE["tpu_fallback_sigs"]
+        self.crypto_breaker_opens._values[()] = RESILIENCE["tpu_breaker_opens"]
+        self.crypto_breaker_probes._values[()] = RESILIENCE["tpu_breaker_probes"]
         return self.registry.render()
 
 

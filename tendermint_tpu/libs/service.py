@@ -34,19 +34,59 @@ class Service:
         self.logger.debug("starting %s", self.name)
         await self.on_start()
 
+    #: per-task reap grace before a second cancel is issued (stop() must
+    #: terminate even when a task resists cancellation)
+    STOP_GRACE = 2.0
+
     async def stop(self) -> None:
         if not self._started or self._stopping:
             return
         self._stopping = True
         self.logger.debug("stopping %s", self.name)
         await self.on_stop()
-        for t in self._tasks:
+        # Reap with three hardenings over a naive `for t: await t`:
+        #  * snapshot + re-scan: done-callbacks mutate self._tasks during
+        #    the loop (a live-list `for` skips entries), and teardown
+        #    paths legitimately spawn late tasks (e.g. _disconnect_peer)
+        #    that must be reaped too;
+        #  * re-cancel on timeout: pre-3.11 asyncio.wait_for can ABSORB a
+        #    cancellation that races the inner future's completion,
+        #    leaving a "cancelled" task running its loop forever — the
+        #    second cancel lands at its next await;
+        #  * bounded waits: a task that still refuses to die is logged
+        #    and abandoned rather than wedging the whole shutdown.
+        seen: set[asyncio.Task] = set()
+        queue = list(self._tasks)
+        # broadcast the first cancel to EVERY task up front: the reap below
+        # is sequential, and a stuck task must not delay its siblings'
+        # cancellation (they'd keep routing/dialing mid-shutdown)
+        for t in queue:
             t.cancel()
-        for t in self._tasks:
-            try:
-                await t
-            except BaseException:  # noqa: B036 — reaping; outcomes are logged elsewhere
-                pass
+        while queue:
+            t = queue.pop()
+            if t in seen:
+                continue
+            seen.add(t)
+            # asyncio.wait (unlike wait_for) neither cancels nor awaits the
+            # task on timeout, so each grace window is a TRUE bound even
+            # against a task that absorbs cancellation
+            t.cancel()
+            _done, not_done = await asyncio.wait({t}, timeout=self.STOP_GRACE)
+            if not_done:
+                t.cancel()
+                _done, not_done = await asyncio.wait({t}, timeout=self.STOP_GRACE)
+                if not_done:
+                    self.logger.warning(
+                        "%s: task %s did not stop; abandoning",
+                        self.name,
+                        t.get_name(),
+                    )
+            if t.done() and not t.cancelled():
+                t.exception()  # consume, silencing 'never retrieved'
+            # teardown paths legitimately spawn late tasks (e.g.
+            # _disconnect_peer); queue them un-cancelled so their cleanup
+            # runs — the bounded reap cancels them when their turn comes
+            queue.extend(x for x in self._tasks if x not in seen and x not in queue)
         self._tasks.clear()
         self._stopped.set()
 

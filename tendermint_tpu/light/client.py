@@ -16,10 +16,6 @@ import time
 from dataclasses import dataclass
 from fractions import Fraction
 
-
-async def _as_ready(value):
-    return value
-
 from ..store.db import DB, MemDB
 from . import verifier
 from .provider import LightBlockNotFoundError, Provider, ProviderError
@@ -27,6 +23,39 @@ from .types import LightBlock
 from .verifier import ErrNewValSetCantBeTrusted, VerificationError
 
 _LB_PREFIX = b"lb/"
+
+#: ceiling on concurrent light-block fetches against a single provider
+#: (the windowed sequential verifier would otherwise issue up to a full
+#: 128-height window at once)
+FETCH_CONCURRENCY = 16
+
+
+async def _as_ready(value):
+    return value
+
+
+async def _gather_cancelling(coros: list) -> list:
+    """gather() that bounds concurrency with a semaphore and, on the
+    first failure, CANCELS every in-flight sibling and awaits them
+    (no stray 'exception was never retrieved' tasks) before re-raising."""
+    sem = asyncio.Semaphore(FETCH_CONCURRENCY)
+
+    async def bounded(coro):
+        try:
+            async with sem:
+                return await coro
+        except asyncio.CancelledError:
+            coro.close()  # no-op if already started; silences never-awaited
+            raise
+
+    tasks = [asyncio.ensure_future(bounded(c)) for c in coros]
+    try:
+        return list(await asyncio.gather(*tasks))
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise
 
 
 @dataclass(frozen=True)
@@ -186,18 +215,17 @@ class LightClient:
             # fetches are independent (verification is deferred to the
             # end of the window), so issue them concurrently — over a
             # real provider the serial RPC round-trips dominate, not the
-            # signature math
-            chain = list(
-                await asyncio.gather(
-                    *(
-                        (
-                            _as_ready(target)
-                            if hh == target.height
-                            else self.primary.light_block(hh)
-                        )
-                        for hh in range(h, top + 1)
+            # signature math. Concurrency is semaphore-bounded and a
+            # failed fetch cancels its in-flight siblings.
+            chain = await _gather_cancelling(
+                [
+                    (
+                        _as_ready(target)
+                        if hh == target.height
+                        else self.primary.light_block(hh)
                     )
-                )
+                    for hh in range(h, top + 1)
+                ]
             )
             trusted = verifier.verify_adjacent_chain(
                 self.chain_id, trusted, chain, self.trust_options.period_ns, now_ns

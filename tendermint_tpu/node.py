@@ -92,6 +92,12 @@ class NodeConfig:
     # longer than watchdog_threshold_s. Empty disables.
     watchdog_dir: str = ""
     watchdog_threshold_s: float = 5.0
+    # chaos-net fault injection (libs/chaos.py): a ChaosConfig (or a
+    # shared ChaosNetwork for multi-node in-process tests that need
+    # partitions) threaded under every transport. None also consults the
+    # TMTPU_CHAOS_* env vars so any node can run under fault load without
+    # code changes.
+    chaos: object | None = None
 
 
 class Node(Service):
@@ -139,6 +145,7 @@ class Node(Service):
 
             addr_book = AddressBook(config.addr_book_path)
         self.peer_manager = PeerManager(self.node_id, addr_book=addr_book)
+        transports = self._maybe_wrap_chaos(transports)
         self.router = Router(
             self.node_info, self.node_key, self.peer_manager, transports
         )
@@ -158,6 +165,41 @@ class Node(Service):
         self.sink = None
         self.rpc_server = None
         self.state = None
+
+    def _maybe_wrap_chaos(self, transports: list[Transport]) -> list[Transport]:
+        """Thread the chaos-net fault layer under the router when
+        configured (NodeConfig.chaos or TMTPU_CHAOS_* env)."""
+        from .config import ChaosNetConfig
+        from .libs.chaos import ChaosConfig, ChaosNetwork
+
+        self.chaos_net = None
+        cfg = self.config.chaos
+        if isinstance(cfg, ChaosNetConfig):  # the TOML config section
+            if not cfg.enabled:
+                # an EXPLICIT disable in the config file wins over any
+                # TMTPU_CHAOS_* env vars inherited from the environment
+                return transports
+            cfg = ChaosConfig(
+                seed=cfg.seed,
+                drop_rate=cfg.drop_rate,
+                delay_ms=cfg.delay_ms,
+                duplicate_rate=cfg.duplicate_rate,
+                reorder_rate=cfg.reorder_rate,
+                corrupt_rate=cfg.corrupt_rate,
+            )
+        if isinstance(cfg, ChaosNetwork):  # shared controller (test nets)
+            self.chaos_net = cfg
+        elif isinstance(cfg, ChaosConfig):
+            if cfg.enabled():
+                self.chaos_net = ChaosNetwork(cfg)
+        elif cfg is None:
+            env = ChaosConfig.from_env()
+            if env.enabled():
+                self.chaos_net = ChaosNetwork(env)
+        if self.chaos_net is None:
+            return transports
+        self.logger.warning("chaos-net fault injection ENABLED: %s", self.chaos_net.config)
+        return [self.chaos_net.wrap(t, self.node_id) for t in transports]
 
     # -- channels --------------------------------------------------------
 
@@ -333,6 +375,7 @@ class Node(Service):
                         m = self.blocksync_reactor.metrics
                         self.metrics.blocksync_applied._values[()] = m["blocks_applied"]
                         self.metrics.blocksync_sigs._values[()] = m["sigs_verified"]
+                        self.metrics.blocksync_bans._values[()] = m["peer_bans"]
                 except Exception:
                     pass
 

@@ -140,7 +140,7 @@ class Router(Service):
     async def _route_channel(self, ch: Channel) -> None:
         """Move envelopes from a channel's out queue to peer queues
         (reference routeChannel router.go:416)."""
-        while True:
+        while self.is_running:
             env = await ch.out_q.get()
             if env.broadcast:
                 targets = list(self._peers.keys())
@@ -167,7 +167,7 @@ class Router(Service):
                     self.logger.warning("dropping message to %s: queue full", nid[:12])
 
     async def _route_errors(self, ch: Channel) -> None:
-        while True:
+        while self.is_running:
             err = await ch.err_q.get()
             self.peer_manager.errored(err)
             if err.fatal:
@@ -184,7 +184,7 @@ class Router(Service):
 
     async def _accept_peers(self, transport: Transport) -> None:
         """Reference acceptPeers router.go:563."""
-        while True:
+        while self.is_running:
             try:
                 conn = await transport.accept()
             except (ConnectionClosedError, ConnectionError):
@@ -195,8 +195,12 @@ class Router(Service):
             )
 
     async def _dial_peers(self) -> None:
-        """Reference dialPeers router.go:646."""
-        while True:
+        """Reference dialPeers router.go:646. The loop re-checks
+        `is_running`: pre-3.11 asyncio.wait_for (used by wait_for_dialable
+        and the dial timeout) can ABSORB a cancellation that races the
+        inner future, which would otherwise leave this loop running as a
+        zombie after stop()."""
+        while self.is_running:
             address = self.peer_manager.try_dial_next()
             if address is None:
                 await self.peer_manager.wait_for_dialable()

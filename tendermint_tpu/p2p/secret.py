@@ -16,13 +16,30 @@ from __future__ import annotations
 import asyncio
 import struct
 
-from cryptography.hazmat.primitives import hashes as c_hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey,
-    X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+try:
+    from cryptography.hazmat.primitives import hashes as c_hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey,
+        X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+
+    def _hkdf96(shared: bytes) -> bytes:
+        return HKDF(
+            algorithm=c_hashes.SHA256(), length=96, salt=None, info=HKDF_INFO
+        ).derive(shared)
+
+except ImportError:  # degraded path: pure-Python RFC 7748/5869/8439
+    from ..crypto.softcrypto import (
+        ChaCha20Poly1305,
+        X25519PrivateKey,
+        X25519PublicKey,
+        hkdf_sha256,
+    )
+
+    def _hkdf96(shared: bytes) -> bytes:
+        return hkdf_sha256(shared, 96, HKDF_INFO)
 
 from ..crypto import ed25519
 from ..libs import protoenc as pe
@@ -81,12 +98,7 @@ class SecretStream:
 
         shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
         loc_is_least = eph_pub < their_eph
-        okm = HKDF(
-            algorithm=c_hashes.SHA256(),
-            length=96,
-            salt=None,
-            info=HKDF_INFO,
-        ).derive(shared)
+        okm = _hkdf96(shared)
         if loc_is_least:
             recv_key, send_key = okm[:32], okm[32:64]
         else:

@@ -24,6 +24,7 @@ from dataclasses import dataclass
 from fractions import Fraction
 
 from ..abci import types as abci
+from ..libs.retry import BackoffPolicy, CircuitBreaker
 from ..libs.service import Service
 from ..light.client import LightClient, TrustOptions, TrustedStore
 from ..light.provider import LightBlockNotFoundError, Provider
@@ -39,6 +40,10 @@ from . import messages as m
 DISCOVERY_TIME = 2.0
 CHUNK_TIMEOUT = 10.0
 CHUNK_FETCHERS = 4
+# inter-attempt backoff for peer fetches (light blocks, chunks, params):
+# full jitter keeps a burst of failed fetchers from re-hammering the same
+# peer in lockstep
+FETCH_BACKOFF = BackoffPolicy(base=0.05, cap=2.0)
 
 
 @dataclass(frozen=True)
@@ -78,7 +83,11 @@ class _Dispatcher(Provider):
         if not peers:
             raise LightBlockNotFoundError("no peers to fetch light blocks from")
         last_err: Exception | None = None
-        for attempt in range(len(peers)):
+        missing_from: set[str] = set()
+        # two round-robin passes with jittered backoff between failures: a
+        # request dropped by a lossy link gets a second chance at the same
+        # peer instead of failing the whole backfill step
+        for attempt in range(2 * len(peers)):
             peer = peers[(self._rr + attempt) % len(peers)]
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._pending[height] = fut
@@ -91,8 +100,14 @@ class _Dispatcher(Provider):
                     self._rr += 1
                     return lb
                 last_err = LightBlockNotFoundError(f"peer {peer[:12]} lacks {height}")
+                missing_from.add(peer)
+                if len(missing_from) >= len(peers):
+                    # every DISTINCT peer answered "don't have it" (a peer
+                    # that merely timed out still gets its second pass)
+                    break
             except asyncio.TimeoutError:
                 last_err = LightBlockNotFoundError(f"timeout from {peer[:12]}")
+                await asyncio.sleep(FETCH_BACKOFF.sleep_for(attempt))
             finally:
                 self._pending.pop(height, None)
         raise last_err or LightBlockNotFoundError(str(height))
@@ -143,6 +158,17 @@ class StateSyncReactor(Service):
         self._snapshots: dict[tuple[int, int], tuple[m.SnapshotsResponse, set[str]]] = {}
         self._chunk_futures: dict[tuple[int, int, int], asyncio.Future] = {}
         self._params_futures: dict[int, asyncio.Future] = {}
+        # per-provider chunk-serving health: a peer that repeatedly times
+        # out is skipped (fail fast) until its breaker half-opens
+        self._peer_breakers: dict[str, CircuitBreaker] = {}
+
+    def _breaker(self, peer: str) -> CircuitBreaker:
+        br = self._peer_breakers.get(peer)
+        if br is None:
+            br = self._peer_breakers[peer] = CircuitBreaker(
+                failure_threshold=4, reset_timeout=10.0, name=f"ss-{peer[:8]}"
+            )
+        return br
 
     async def on_start(self) -> None:
         self.spawn(self._process_peer_updates(), name="ssr.peers")
@@ -310,6 +336,17 @@ class StateSyncReactor(Service):
         async def fetch(idx: int) -> None:
             async with sem:
                 for attempt, peer in enumerate(providers * 3):
+                    br = self._breaker(peer)
+                    # `state` is a side-effect-free read; allow() claims the
+                    # half-open probe slot, so only consult it for the peer
+                    # actually about to be used
+                    others_healthy = any(
+                        self._breaker(p).state != "open"
+                        for p in providers
+                        if p != peer
+                    )
+                    if others_healthy and not br.allow():
+                        continue  # skip tripped peers while healthy ones remain
                     fut: asyncio.Future = asyncio.get_running_loop().create_future()
                     self._chunk_futures[(snap.height, snap.format, idx)] = fut
                     self._send(
@@ -319,10 +356,16 @@ class StateSyncReactor(Service):
                     )
                     try:
                         res = await asyncio.wait_for(fut, CHUNK_TIMEOUT)
+                        # any reply is a healthy transport — record success
+                        # even for 'missing' so a claimed half-open probe
+                        # slot is always released
+                        br.record_success()
                         if not res.missing:
                             chunks[idx] = res.chunk
                             return
                     except asyncio.TimeoutError:
+                        br.record_failure()
+                        await asyncio.sleep(FETCH_BACKOFF.sleep_for(attempt))
                         continue
                     finally:
                         self._chunk_futures.pop((snap.height, snap.format, idx), None)
@@ -397,7 +440,7 @@ class StateSyncReactor(Service):
     async def _fetch_params(self, height: int, providers: list[str]):
         from ..types.params import ConsensusParams
 
-        for peer in providers:
+        for attempt, peer in enumerate(providers * 2):
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._params_futures[height] = fut
             self._send(self.params_ch, m.ParamsRequest(height), to=peer)
@@ -406,6 +449,7 @@ class StateSyncReactor(Service):
                 if params is not None:
                     return params
             except asyncio.TimeoutError:
+                await asyncio.sleep(FETCH_BACKOFF.sleep_for(attempt))
                 continue
             finally:
                 self._params_futures.pop(height, None)

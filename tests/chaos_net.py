@@ -1,0 +1,271 @@
+"""Shared chaos-net test harness: a deterministic pre-built chain served
+over REAL routers + chaos-wrapped in-memory transports to N block-syncing
+nodes. Used by the seeded chaos matrix in test_p2p_robustness.py and the
+crash-under-chaos tests in test_crash_recovery.py.
+
+Why blocksync (not live consensus) for the reproducibility assertions:
+the source chain is built with deterministic keys and timestamps, so the
+protocol OUTPUT — the block hashes every node converges to — is
+bit-identical across invocations regardless of fault timing; live
+consensus embeds wall-clock vote timestamps in the hashes and cannot
+make that promise."""
+
+from __future__ import annotations
+
+import asyncio
+
+from tendermint_tpu.abci.kvstore import KVStoreApp
+from tendermint_tpu.blocksync import BLOCKSYNC_CHANNEL
+from tendermint_tpu.blocksync import messages as bsm
+from tendermint_tpu.blocksync.reactor import BlockSyncReactor
+from tendermint_tpu.consensus.replay import Handshaker
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.libs.chaos import ChaosConfig, ChaosNetwork
+from tendermint_tpu.p2p.memory import MemoryNetwork
+from tendermint_tpu.p2p.peermanager import PeerManager
+from tendermint_tpu.p2p.router import Router
+from tendermint_tpu.p2p.types import NodeAddress, NodeInfo, node_id_from_pubkey
+from tendermint_tpu.proxy import AppConns
+from tendermint_tpu.state.execution import BlockExecutor
+from tendermint_tpu.state.state import state_from_genesis
+from tendermint_tpu.state.store import StateStore
+from tendermint_tpu.store.blockstore import BlockStore
+from tendermint_tpu.store.db import MemDB
+
+
+class ChaosNode:
+    """One router + blocksync reactor over a chaos-wrapped transport."""
+
+    def __init__(self, net: "ChaosSyncNet", index: int, chain_id: str):
+        self.index = index
+        self.priv_key = ed25519.Ed25519PrivKey(bytes([0x60 + index]) * 32)
+        self.node_id = node_id_from_pubkey(self.priv_key.pub_key())
+        self.node_info = NodeInfo(
+            node_id=self.node_id, network=chain_id, moniker=f"chaos{index}"
+        )
+        inner = net.memory.create_transport(self.node_id)
+        self.transport = net.chaos.wrap(inner, self.node_id)
+        self.peer_manager = PeerManager(self.node_id, max_connected=64)
+        self.router = Router(
+            self.node_info, self.priv_key, self.peer_manager, [self.transport]
+        )
+        self.channel = self.router.open_channel(
+            BLOCKSYNC_CHANNEL,
+            name="blocksync",
+            priority=5,
+            encode=bsm.encode_message,
+            decode=bsm.decode_message,
+        )
+        self.reactor: BlockSyncReactor | None = None
+        self.app_conns: AppConns | None = None
+        self.block_store: BlockStore | None = None
+        self.state_store: StateStore | None = None
+
+    def address(self) -> NodeAddress:
+        return NodeAddress(node_id=self.node_id, protocol="memory")
+
+
+class ChaosSyncNet:
+    """Node 0 serves `src_store`; nodes 1..n_sync block-sync it under the
+    fault plan in `chaos_cfg`."""
+
+    def __init__(
+        self,
+        genesis,
+        src_store,
+        src_state,
+        chaos_cfg: ChaosConfig,
+        *,
+        n_sync: int = 3,
+        window: int = 8,
+    ):
+        self.genesis = genesis
+        self.src_store = src_store
+        self.src_state = src_state
+        self.memory = MemoryNetwork()
+        self.chaos = ChaosNetwork(chaos_cfg)
+        self.window = window
+        self.nodes = [
+            ChaosNode(self, i, genesis.chain_id) for i in range(n_sync + 1)
+        ]
+
+    @property
+    def source(self) -> ChaosNode:
+        return self.nodes[0]
+
+    @property
+    def sync_nodes(self) -> list[ChaosNode]:
+        return self.nodes[1:]
+
+    async def start(self) -> None:
+        # source: serve-only reactor over the pre-built store
+        src = self.source
+        src.block_store = self.src_store
+        src.reactor = BlockSyncReactor(
+            self.src_state,
+            None,  # block_exec unused when inactive
+            self.src_store,
+            src.channel,
+            src.peer_manager.subscribe(),
+            active=False,
+        )
+        for node in self.sync_nodes:
+            await self._setup_sync_node(node)
+        for node in self.nodes:
+            await node.router.start()
+            await node.reactor.start()
+        for i, a in enumerate(self.nodes):
+            for b in self.nodes[i + 1 :]:
+                a.peer_manager.add_address(b.address())
+        # the harness analog of node.py's _lag_monitor: a reactor that
+        # declared caught-up while a taller peer exists (possible when the
+        # source's status responses were delayed/dropped at startup) is
+        # resumed — production nodes do exactly this switch-back
+        self._lag_tasks = [
+            asyncio.get_running_loop().create_task(self._lag_monitor(i))
+            for i in range(1, len(self.nodes))
+        ]
+
+    async def _lag_monitor(self, idx: int) -> None:
+        while True:
+            await asyncio.sleep(0.5)
+            node = self.nodes[idx]  # restart_sync_node swaps the object
+            r = node.reactor
+            if (
+                r is not None
+                and r.synced.is_set()
+                and r.pool.max_peer_height() > node.block_store.height()
+            ):
+                r.resume(r.state)
+
+    async def _setup_sync_node(self, node: ChaosNode) -> None:
+        app = KVStoreApp()
+        node.app_conns = AppConns.local(app)
+        await node.app_conns.start()
+        node.block_store = BlockStore(MemDB())
+        node.state_store = StateStore(MemDB())
+        state = await Handshaker(
+            node.state_store,
+            state_from_genesis(self.genesis),
+            node.block_store,
+            self.genesis,
+        ).handshake(node.app_conns)
+        node.state_store.save(state)
+        block_exec = BlockExecutor(
+            node.state_store,
+            node.app_conns.consensus,
+            block_store=node.block_store,
+        )
+        node.reactor = BlockSyncReactor(
+            state,
+            block_exec,
+            node.block_store,
+            node.channel,
+            node.peer_manager.subscribe(),
+            window=self.window,
+            active=True,
+        )
+
+    async def restart_sync_node(self, node: ChaosNode) -> ChaosNode:
+        """Crash-and-restart: stop the node's reactor+router, then bring a
+        NEW reactor up on the SAME stores/app under a fresh router task set
+        (the in-process analog of a process restart mid-sync)."""
+        await node.reactor.stop()
+        await node.router.stop()
+        fresh = ChaosNode(self, node.index, self.genesis.chain_id)
+        fresh.app_conns = node.app_conns
+        fresh.block_store = node.block_store
+        fresh.state_store = node.state_store
+        state = node.state_store.load()
+        block_exec = BlockExecutor(
+            fresh.state_store,
+            fresh.app_conns.consensus,
+            block_store=fresh.block_store,
+        )
+        fresh.reactor = BlockSyncReactor(
+            state,
+            block_exec,
+            fresh.block_store,
+            fresh.channel,
+            fresh.peer_manager.subscribe(),
+            window=self.window,
+            active=True,
+        )
+        self.nodes[self.nodes.index(node)] = fresh
+        await fresh.router.start()
+        await fresh.reactor.start()
+        for other in self.nodes:
+            if other is not fresh:
+                fresh.peer_manager.add_address(other.address())
+                other.peer_manager.add_address(fresh.address())
+        return fresh
+
+    async def wait_synced(self, target: int, timeout: float = 90.0) -> None:
+        async def one(node: ChaosNode):
+            while node.block_store.height() < target:
+                await asyncio.sleep(0.05)
+
+        await asyncio.wait_for(
+            asyncio.gather(*(one(n) for n in self.sync_nodes)), timeout
+        )
+
+    def hashes_at(self, target: int) -> list[bytes]:
+        """Block hash at `target` per sync node (the reproducibility
+        fingerprint)."""
+        return [
+            n.block_store.load_block(target).hash() for n in self.sync_nodes
+        ]
+
+    async def stop(self) -> None:
+        for t in getattr(self, "_lag_tasks", []):
+            t.cancel()
+        for node in self.nodes:
+            if node.reactor is not None:
+                await node.reactor.stop()
+            await node.router.stop()
+            if node.app_conns is not None:
+                await node.app_conns.stop()
+
+
+async def run_chaos_sync(
+    chaos_cfg: ChaosConfig,
+    *,
+    n_blocks: int = 16,
+    n_sync: int = 3,
+    window: int = 8,
+    partition_cycle: bool = False,
+    partition_at: float = 0.3,
+    partition_for: float = 1.2,
+    timeout: float = 90.0,
+):
+    """Build a deterministic chain, sync it through the chaos net, return
+    (target_height, per-node hashes at target, chaos fault counters).
+
+    With partition_cycle=True, one partition-and-heal cycle is injected
+    mid-sync: {source, node1} | {node2, node3, ...} for `partition_for`
+    seconds starting `partition_at` seconds after the net comes up."""
+    from tendermint_tpu.testing import build_kvstore_chain
+
+    bstore, sstore, conns, genesis, _keys = await build_kvstore_chain(
+        n_blocks, 3, chain_id="chaos-chain"
+    )
+    src_state = sstore.load()
+    net = ChaosSyncNet(
+        genesis, bstore, src_state, chaos_cfg, n_sync=n_sync, window=window
+    )
+    target = n_blocks - 1  # the tip needs its successor's commit to apply
+    await net.start()
+    try:
+        if partition_cycle:
+            ids = [n.node_id for n in net.nodes]
+            # let some progress happen, then split the net and heal it
+            await asyncio.sleep(partition_at)
+            net.chaos.partition(set(ids[:2]), set(ids[2:]))
+            await asyncio.sleep(partition_for)
+            net.chaos.heal()
+        await net.wait_synced(target, timeout)
+        hashes = net.hashes_at(target)
+    finally:
+        await net.stop()
+        await conns.stop()
+    return target, hashes, dict(net.chaos.faults)

@@ -1,0 +1,237 @@
+"""Blocksync resilience: adaptive per-peer RTO + health scoring +
+timeout bans (pool), and the commit-verification regression from
+ADVICE.md — the FIRST block applied after startup/resume must be
+full-signature-verified (commit_verified=False), because a range batch
+proves the commits for its own heights, never the commit for the height
+below its first block."""
+
+import asyncio
+import time
+
+import pytest
+
+from tendermint_tpu.blocksync import BLOCKSYNC_CHANNEL
+from tendermint_tpu.blocksync import messages as bsm
+from tendermint_tpu.blocksync import pool as pool_mod
+from tendermint_tpu.blocksync.pool import BlockPool
+from tendermint_tpu.blocksync.reactor import BlockSyncReactor
+from tendermint_tpu.p2p.peermanager import PeerStatus, PeerUpdate
+from tendermint_tpu.p2p.router import Channel
+from tendermint_tpu.p2p.types import Envelope
+
+
+class _FakeBlock:
+    def __init__(self, height: int):
+        self.header = type("H", (), {"height": height})()
+
+
+class TestAdaptiveTimeouts:
+    def test_rto_learns_from_rtt_samples(self):
+        pool = BlockPool(1)
+        pool.set_peer_range("p1", 1, 100)
+        p = pool.peers["p1"]
+        assert p.request_timeout() == pool_mod.INITIAL_REQUEST_TIMEOUT
+        for _ in range(8):
+            p.observe_rtt(0.05)
+        # Jacobson RTO = srtt + 4*rttvar, floored
+        assert (
+            pool_mod.MIN_REQUEST_TIMEOUT
+            <= p.request_timeout()
+            <= 0.05 * 8  # well under the old fixed 15 s
+        )
+
+    def test_rto_doubles_per_consecutive_timeout(self):
+        pool = BlockPool(1)
+        pool.set_peer_range("p1", 1, 100)
+        p = pool.peers["p1"]
+        p.observe_rtt(0.1)
+        base = p.request_timeout()
+        p.timeouts = 2
+        assert p.request_timeout() == pytest.approx(min(base * 4, pool_mod.REQUEST_TIMEOUT))
+        p.timeouts = 30  # ceiling holds
+        assert p.request_timeout() == pool_mod.REQUEST_TIMEOUT
+
+    def test_block_arrival_records_rtt_and_resets_timeouts(self):
+        pool = BlockPool(1)
+        pool.set_peer_range("p1", 1, 100)
+        reqs = pool.next_requests()
+        assert reqs and reqs[0][1] == "p1"
+        pool.peers["p1"].timeouts = 3
+        h = reqs[0][0]
+        pool.add_block("p1", _FakeBlock(h))
+        p = pool.peers["p1"]
+        assert p.srtt > 0 and p.timeouts == 0 and p.blocks_served == 1
+
+    def test_health_prefers_responsive_peer(self):
+        pool = BlockPool(1)
+        pool.set_peer_range("fast", 1, 100)
+        pool.set_peer_range("flaky", 1, 100)
+        pool.peers["fast"].observe_rtt(0.01)
+        pool.peers["flaky"].observe_rtt(0.01)
+        pool.peers["flaky"].timeouts = 2
+        picked = {pool._pick_peer(h).peer_id for h in range(1, 4)}
+        assert picked == {"fast"}
+
+    def test_ban_after_consecutive_timeouts_with_cooldown(self):
+        pool = BlockPool(1)
+        pool.set_peer_range("p1", 1, 100)
+        p = pool.peers["p1"]
+        p.observe_rtt(0.001)  # tiny RTO so timeouts fire immediately
+        for _ in range(pool_mod.BAN_AFTER_TIMEOUTS):
+            reqs = pool.next_requests()
+            assert reqs, "peer should still be assignable before the ban"
+            # age every outstanding request past any RTO
+            for req in pool.requests.values():
+                req.time -= pool_mod.REQUEST_TIMEOUT + 1
+            p.timeouts = p.timeouts  # (clarity: consecutive count grows below)
+            pool.next_requests()
+            if "p1" not in pool.peers:
+                break
+        assert pool.take_banned() == ["p1"]
+        assert pool.take_banned() == []  # drained
+        # quarantined: re-registration is ignored until the cooldown passes
+        pool.set_peer_range("p1", 1, 100)
+        assert "p1" not in pool.peers
+        pool._ban_until["p1"] = time.monotonic() - 1  # cooldown elapsed
+        pool.set_peer_range("p1", 1, 100)
+        assert "p1" in pool.peers
+
+
+def _make_sync_stack(genesis, window):
+    """Fresh store/executor/reactor wired to a bare channel (the
+    test_blocksync_rotation serve pattern)."""
+    from tendermint_tpu.abci.kvstore import KVStoreApp
+    from tendermint_tpu.consensus.replay import Handshaker
+    from tendermint_tpu.proxy import AppConns
+    from tendermint_tpu.state.execution import BlockExecutor
+    from tendermint_tpu.state.state import state_from_genesis
+    from tendermint_tpu.state.store import StateStore
+    from tendermint_tpu.store.blockstore import BlockStore
+    from tendermint_tpu.store.db import MemDB
+
+    async def build():
+        app = KVStoreApp()
+        conns = AppConns.local(app)
+        await conns.start()
+        bstore, sstore = BlockStore(MemDB()), StateStore(MemDB())
+        state = await Handshaker(
+            sstore, state_from_genesis(genesis), bstore, genesis
+        ).handshake(conns)
+        sstore.save(state)
+        ex = BlockExecutor(sstore, conns.consensus, block_store=bstore)
+        ch = Channel(
+            BLOCKSYNC_CHANNEL, "bs", 5, bsm.encode_message, bsm.decode_message
+        )
+        peer_q: asyncio.Queue = asyncio.Queue()
+        reactor = BlockSyncReactor(
+            state, ex, bstore, ch, peer_q, window=window, active=True
+        )
+        return conns, bstore, ex, ch, peer_q, reactor
+
+    return build()
+
+
+class TestFirstBlockFullVerify:
+    @pytest.mark.asyncio
+    async def test_first_block_after_start_and_resume_full_verified(self):
+        """The first block applied after startup AND after resume() must
+        take the full apply-time verification path (commit_verified=False);
+        blocks whose predecessor commit a range batch proved may skip."""
+        from tendermint_tpu.testing import build_kvstore_chain
+
+        n_blocks = 20
+        src_store, _sstore, src_conns, genesis, _ = await build_kvstore_chain(
+            n_blocks, 3, chain_id="fv-chain"
+        )
+        conns, bstore, ex, ch, peer_q, reactor = await _make_sync_stack(
+            genesis, window=6
+        )
+        applied: list[tuple[int, bool]] = []
+        orig_apply = ex.apply_block
+
+        async def spy_apply(state, block_id, block, commit_verified=False):
+            applied.append((block.header.height, commit_verified))
+            return await orig_apply(
+                state, block_id, block, commit_verified=commit_verified
+            )
+
+        ex.apply_block = spy_apply
+
+        # phase 1: serve only the first 12 heights (simulates the peer's
+        # visible head); phase 2 extends to the full chain after resume
+        served_height = 12
+
+        async def serve():
+            while True:
+                env = await ch.out_q.get()
+                msg = env.message
+                if isinstance(msg, bsm.StatusRequest):
+                    await ch.in_q.put(
+                        Envelope(
+                            BLOCKSYNC_CHANNEL,
+                            bsm.StatusResponse(served_height, src_store.base()),
+                            from_="peer0",
+                        )
+                    )
+                elif isinstance(msg, bsm.BlockRequest):
+                    blk = (
+                        src_store.load_block(msg.height)
+                        if msg.height <= served_height
+                        else None
+                    )
+                    if blk is not None:
+                        await ch.in_q.put(
+                            Envelope(
+                                BLOCKSYNC_CHANNEL,
+                                bsm.BlockResponse(blk),
+                                from_="peer0",
+                            )
+                        )
+                    else:
+                        await ch.in_q.put(
+                            Envelope(
+                                BLOCKSYNC_CHANNEL,
+                                bsm.NoBlockResponse(msg.height),
+                                from_="peer0",
+                            )
+                        )
+
+        server = asyncio.get_running_loop().create_task(serve())
+        await peer_q.put(PeerUpdate("peer0", PeerStatus.UP))
+        await reactor.start()
+        try:
+            await asyncio.wait_for(reactor.synced.wait(), timeout=60)
+            assert bstore.height() >= served_height - 1
+            # startup: first applied block full-verified, the rest of its
+            # range batch-proven
+            assert applied[0][0] == 1 and applied[0][1] is False
+            in_range = [cv for h, cv in applied if 2 <= h <= 6]
+            assert any(in_range), "batch proof never exercised"
+
+            # phase 2: the chain grew while we were in consensus; resume
+            applied.clear()
+            served_height = n_blocks
+            # the peer advertises its taller chain before we switch back
+            await ch.in_q.put(
+                Envelope(
+                    BLOCKSYNC_CHANNEL,
+                    bsm.StatusResponse(served_height, src_store.base()),
+                    from_="peer0",
+                )
+            )
+            await asyncio.sleep(0.1)
+            reactor.resume(reactor.state)
+            await asyncio.wait_for(reactor.synced.wait(), timeout=60)
+            assert bstore.height() >= n_blocks - 1
+            first_h, first_cv = applied[0]
+            assert first_cv is False, (
+                f"first block after resume (h={first_h}) skipped full verify"
+            )
+            assert any(cv for _h, cv in applied[1:]), (
+                "post-resume range batches never proved commits"
+            )
+        finally:
+            server.cancel()
+            await reactor.stop()
+            await conns.stop()
+            await src_conns.stop()

@@ -195,9 +195,11 @@ class TestXChaCha20Poly1305:
         import os
 
         import pytest
-        from cryptography.exceptions import InvalidTag
 
-        from tendermint_tpu.crypto.xchacha20poly1305 import XChaCha20Poly1305
+        from tendermint_tpu.crypto.xchacha20poly1305 import (
+            InvalidTag,
+            XChaCha20Poly1305,
+        )
 
         key = os.urandom(32)
         aead = XChaCha20Poly1305(key)
@@ -217,3 +219,103 @@ class TestXChaCha20Poly1305:
 
         k = bytes(range(32))
         assert hchacha20(k, bytes(16)) != hchacha20(k, b"\x01" + bytes(15))
+
+
+class TestTPUDegradation:
+    """crypto/batch.py: a TPU-backend failure mid-batch must degrade to
+    the CPU path with IDENTICAL results, trip the circuit breaker, and a
+    later half-open probe must restore TPU routing."""
+
+    def _batch(self, n=6, bad=3):
+        keys = [ed25519.Ed25519PrivKey.generate() for _ in range(n)]
+        items = []
+        for i, k in enumerate(keys):
+            msg = b"degrade-%d" % i
+            sig = k.sign(msg)
+            if i == bad:
+                sig = sig[:-1] + bytes([sig[-1] ^ 1])
+            items.append((k.pub_key(), msg, sig))
+        return items
+
+    def test_fallback_identical_results_breaker_opens_then_probes(self, monkeypatch):
+        from tendermint_tpu.crypto import batch as batch_mod
+        from tendermint_tpu.libs.metrics import RESILIENCE
+        from tendermint_tpu.libs.retry import CircuitBreaker
+
+        class FakeClock:
+            now = 1000.0
+
+            def __call__(self):
+                return self.now
+
+        clock = FakeClock()
+        breaker = CircuitBreaker(
+            failure_threshold=1, reset_timeout=30.0, clock=clock, name="t"
+        )
+        monkeypatch.setattr(batch_mod, "_tpu_breaker", breaker)
+        monkeypatch.setattr(batch_mod, "tpu_verifier_available", lambda: True)
+        monkeypatch.setattr(batch_mod, "MIN_TPU_BATCH", 1)
+
+        crashes = {"n": 0}
+        tpu_calls = {"n": 0}
+
+        class CrashingTPU(CPUBatchVerifier):
+            def verify(self):
+                crashes["n"] += 1
+                raise RuntimeError("simulated TPU backend crash mid-batch")
+
+        class HealthyTPU(CPUBatchVerifier):
+            def verify(self):
+                tpu_calls["n"] += 1
+                return super().verify()
+
+        items = self._batch()
+        expect_cpu = CPUBatchVerifier()
+        for pk, msg, sig in items:
+            expect_cpu.add(pk, msg, sig)
+        want = expect_cpu.verify()
+
+        fallback_before = RESILIENCE["tpu_fallback_batches"]
+
+        # 1) crash mid-batch -> transparent CPU fallback, identical tuple
+        monkeypatch.setattr(
+            batch_mod.AdaptiveBatchVerifier,
+            "_make_tpu_verifier",
+            lambda self: CrashingTPU(),
+        )
+        bv = create_batch_verifier(items[0][0])
+        for pk, msg, sig in items:
+            bv.add(pk, msg, sig)
+        got = bv.verify()
+        assert got == want  # same (ok, per-signature) result as pure CPU
+        assert crashes["n"] == 1
+        assert breaker.state == "open"
+        assert RESILIENCE["tpu_fallback_batches"] == fallback_before + 1
+
+        # 2) while open: TPU never touched, CPU results still correct
+        bv = create_batch_verifier(items[0][0])
+        for pk, msg, sig in items:
+            bv.add(pk, msg, sig)
+        assert bv.verify() == want
+        assert crashes["n"] == 1  # no new device attempts
+
+        # 3) reset timeout elapses -> half-open probe restores TPU routing
+        monkeypatch.setattr(
+            batch_mod.AdaptiveBatchVerifier,
+            "_make_tpu_verifier",
+            lambda self: HealthyTPU(),
+        )
+        clock.now += 30.0
+        assert breaker.state == "half-open"
+        bv = create_batch_verifier(items[0][0])
+        for pk, msg, sig in items:
+            bv.add(pk, msg, sig)
+        assert bv.verify() == want
+        assert tpu_calls["n"] == 1  # the probe went to the "device"
+        assert breaker.state == "closed"
+        # 4) and stays on the device afterwards
+        bv = create_batch_verifier(items[0][0])
+        for pk, msg, sig in items:
+            bv.add(pk, msg, sig)
+        assert bv.verify() == want
+        assert tpu_calls["n"] == 2

@@ -167,3 +167,188 @@ class TestSeedMode:
             assert err.node_id == "cc" * 20
         finally:
             await reactor.stop()
+
+
+# ---------------------------------------------------------------------------
+# Chaos-net fault injection (libs/chaos.py): seeded matrix over real routers
+# ---------------------------------------------------------------------------
+
+from tendermint_tpu.libs.chaos import ChaosConfig, ChaosNetwork, _corrupt  # noqa: E402
+from tests.chaos_net import ChaosSyncNet, run_chaos_sync  # noqa: E402
+
+
+class TestChaosUnit:
+    def test_seeded_plan_is_deterministic(self):
+        cfg = ChaosConfig(
+            seed=99, drop_rate=0.2, delay_ms=50.0, duplicate_rate=0.1,
+            reorder_rate=0.1, corrupt_rate=0.1,
+        )
+        plans_a = [ChaosNetwork(cfg).plan("a", "b", 0x40) for _ in range(1)]
+        net1, net2 = ChaosNetwork(cfg), ChaosNetwork(cfg)
+        seq1 = [net1.plan("a", "b", 0x40) for _ in range(200)]
+        seq2 = [net2.plan("a", "b", 0x40) for _ in range(200)]
+        assert seq1 == seq2
+        assert net1.faults == net2.faults
+        assert plans_a is not None  # silence lints; determinism shown above
+
+    def test_partition_semantics_and_heal(self):
+        net = ChaosNetwork(ChaosConfig(seed=1))
+        net.partition({"a", "b"}, {"c"})
+        assert net.partitioned("a", "c") and net.partitioned("c", "b")
+        assert not net.partitioned("a", "b")
+        # ungrouped nodes see everyone
+        assert not net.partitioned("a", "zzz")
+        assert net.plan("a", "c", 0).drop
+        assert net.faults["partition_drop"] == 1
+        net.heal()
+        assert not net.partitioned("a", "c")
+
+    def test_corrupt_flips_exactly_one_byte(self):
+        data = bytes(range(64))
+        out = _corrupt(data, 1337)
+        assert len(out) == len(data)
+        assert sum(1 for x, y in zip(data, out) if x != y) == 1
+
+    def test_per_channel_override(self):
+        cfg = ChaosConfig(
+            seed=5, drop_rate=0.0,
+            per_channel={0x40: ChaosConfig(drop_rate=1.0)},
+        )
+        net = ChaosNetwork(cfg)
+        assert net.plan("a", "b", 0x40).drop  # blocksync channel: all dropped
+        assert not net.plan("a", "b", 0x30).drop
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("TMTPU_CHAOS_SEED", "42")
+        monkeypatch.setenv("TMTPU_CHAOS_DROP", "0.25")
+        monkeypatch.setenv("TMTPU_CHAOS_DELAY_MS", "10")
+        cfg = ChaosConfig.from_env()
+        assert cfg.seed == 42 and cfg.drop_rate == 0.25 and cfg.delay_ms == 10.0
+        assert cfg.enabled()
+        assert not ChaosConfig().enabled()
+
+
+class TestChaosMatrix:
+    """A 4-node in-process net (1 source + 3 syncers over real routers)
+    must reach the target height under each fault class. The chain is
+    deterministic, so the synced hashes are the source's — asserted by
+    uniqueness across nodes."""
+
+    @pytest.mark.asyncio
+    async def test_drop(self):
+        _target, hashes, faults = await run_chaos_sync(
+            ChaosConfig(seed=7, drop_rate=0.1), n_sync=3, timeout=75
+        )
+        assert len(set(hashes)) == 1
+        assert faults["drop"] > 0
+
+    @pytest.mark.asyncio
+    async def test_delay(self):
+        _target, hashes, faults = await run_chaos_sync(
+            ChaosConfig(seed=8, delay_ms=100.0), n_sync=3, timeout=75
+        )
+        assert len(set(hashes)) == 1
+        assert faults["delay"] > 0
+
+    @pytest.mark.asyncio
+    async def test_duplicate_reorder_corrupt(self):
+        _target, hashes, faults = await run_chaos_sync(
+            ChaosConfig(
+                seed=5, duplicate_rate=0.05, reorder_rate=0.05, corrupt_rate=0.02
+            ),
+            n_sync=2,
+            timeout=75,
+        )
+        assert len(set(hashes)) == 1
+        assert faults["duplicate"] + faults["reorder"] + faults["corrupt"] > 0
+
+    @pytest.mark.asyncio
+    async def test_partition_and_heal(self):
+        _target, hashes, faults = await run_chaos_sync(
+            ChaosConfig(seed=9, delay_ms=40.0),
+            n_blocks=32,
+            n_sync=3,
+            partition_cycle=True,
+            partition_at=0.2,
+            partition_for=1.5,
+            timeout=75,
+        )
+        assert len(set(hashes)) == 1
+
+
+class TestChaosSmoke:
+    @pytest.mark.asyncio
+    async def test_acceptance_scenario_bit_reproducible(self):
+        """THE acceptance scenario: fixed seed, 10% drop + 100 ms p50
+        delay + one partition-and-heal cycle; the 4-node net reaches the
+        target height and TWO invocations produce identical block hashes
+        at that height."""
+        cfg = dict(
+            n_blocks=16,
+            n_sync=3,
+            partition_cycle=True,
+            partition_at=0.5,
+            partition_for=1.0,
+            timeout=75,
+        )
+        chaos = ChaosConfig(seed=1234, drop_rate=0.1, delay_ms=100.0)
+        target1, hashes1, faults1 = await run_chaos_sync(chaos, **cfg)
+        target2, hashes2, faults2 = await run_chaos_sync(chaos, **cfg)
+        assert target1 == target2
+        assert len(set(hashes1)) == 1, "nodes diverged within run 1"
+        assert hashes1 == hashes2, "runs are not bit-reproducible"
+        # the fault classes actually fired
+        assert faults1["drop"] > 0 and faults1["delay"] > 0
+        assert faults1["partition_drop"] > 0
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    @pytest.mark.asyncio
+    async def test_soak_repeated_partitions_under_loss(self):
+        """~60 s soak: a longer chain synced under sustained drop+delay
+        with repeated partition/heal cycles; every node must converge to
+        the source chain."""
+        from tendermint_tpu.testing import build_kvstore_chain
+
+        bstore, sstore, conns, genesis, _ = await build_kvstore_chain(
+            96, 3, chain_id="chaos-chain"
+        )
+        net = ChaosSyncNet(
+            genesis,
+            bstore,
+            sstore.load(),
+            ChaosConfig(seed=4242, drop_rate=0.05, delay_ms=50.0),
+            n_sync=3,
+            window=8,
+        )
+        target = 95
+        await net.start()
+        try:
+            ids = [n.node_id for n in net.nodes]
+            deadline = asyncio.get_running_loop().time() + 60
+            cycle = 0
+            # keep cycling partitions for the full soak window (≥12
+            # cycles ≈ 50 s) even if the chain syncs early — late cycles
+            # exercise the caught-up/resume path under faults too
+            while asyncio.get_running_loop().time() < deadline:
+                synced = (
+                    min(n.block_store.height() for n in net.sync_nodes) >= target
+                )
+                if synced and cycle >= 12:
+                    break
+                # alternate split shapes so every node gets isolated
+                if cycle % 2 == 0:
+                    net.chaos.partition(set(ids[:2]), set(ids[2:]))
+                else:
+                    net.chaos.partition({ids[0], ids[3]}, {ids[1], ids[2]})
+                await asyncio.sleep(1.5)
+                net.chaos.heal()
+                await asyncio.sleep(2.5)
+                cycle += 1
+            await net.wait_synced(target, timeout=30)
+            assert len(set(net.hashes_at(target))) == 1
+            assert net.chaos.faults["partition_drop"] > 0
+        finally:
+            await net.stop()
+            await conns.stop()

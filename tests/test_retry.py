@@ -1,0 +1,349 @@
+"""Unit suite for the shared resilience primitives (libs/retry.py):
+backoff growth + full-jitter bounds, deadline/attempt budgets, and the
+circuit breaker's closed → open → half-open → closed/open lifecycle."""
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.libs.retry import (
+    BackoffPolicy,
+    CircuitBreaker,
+    CircuitOpenError,
+    RetriesExhaustedError,
+    retry,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float) -> None:
+        self.now += dt
+
+
+class TestBackoffPolicy:
+    def test_full_jitter_bounds_and_growth(self):
+        policy = BackoffPolicy(base=0.1, cap=5.0, multiplier=2.0)
+        rng = random.Random(42)
+        for attempt in range(12):
+            ceiling = min(5.0, 0.1 * 2**attempt)
+            for _ in range(50):
+                s = policy.sleep_for(attempt, rng)
+                assert 0.0 <= s <= ceiling, (attempt, s)
+
+    def test_cap_applies(self):
+        policy = BackoffPolicy(base=1.0, cap=2.0)
+        rng = random.Random(0)
+        assert all(policy.sleep_for(50, rng) <= 2.0 for _ in range(100))
+
+    def test_seeded_sequence_is_deterministic(self):
+        policy = BackoffPolicy(base=0.1, cap=5.0)
+        a = [policy.sleep_for(i, random.Random(7)) for i in range(8)]
+        b = [policy.sleep_for(i, random.Random(7)) for i in range(8)]
+        assert a == b
+
+    def test_sleeps_respects_max_attempts(self):
+        policy = BackoffPolicy(base=0.01, max_attempts=4)
+        assert len(list(policy.sleeps(random.Random(1)))) == 4
+
+
+class TestRetry:
+    @pytest.mark.asyncio
+    async def test_succeeds_after_transients(self):
+        calls = []
+
+        async def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ValueError("flake")
+            return "ok"
+
+        out = await retry(
+            fn, BackoffPolicy(base=0.0001, max_attempts=10), rng=random.Random(0)
+        )
+        assert out == "ok" and len(calls) == 3
+
+    @pytest.mark.asyncio
+    async def test_attempt_budget_exhausted(self):
+        async def fn():
+            raise ValueError("always")
+
+        with pytest.raises(RetriesExhaustedError) as ei:
+            await retry(
+                fn, BackoffPolicy(base=0.0001, max_attempts=3), rng=random.Random(0)
+            )
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last, ValueError)
+
+    @pytest.mark.asyncio
+    async def test_unlisted_exception_propagates(self):
+        async def fn():
+            raise KeyError("bug, not flake")
+
+        with pytest.raises(KeyError):
+            await retry(
+                fn,
+                BackoffPolicy(base=0.0001, max_attempts=5),
+                retry_on=(ValueError,),
+            )
+
+    @pytest.mark.asyncio
+    async def test_give_up_on_wins_over_retry_on(self):
+        class Transient(Exception):
+            pass
+
+        class Definitive(Transient):
+            pass
+
+        calls = []
+
+        async def fn():
+            calls.append(1)
+            raise Definitive("not found")
+
+        with pytest.raises(Definitive):
+            await retry(
+                fn,
+                BackoffPolicy(base=0.0001, max_attempts=5),
+                retry_on=(Transient,),
+                give_up_on=(Definitive,),
+            )
+        assert len(calls) == 1  # no retries for a definitive answer
+
+    @pytest.mark.asyncio
+    async def test_deadline_enforced_without_sleeping(self):
+        clock = FakeClock()
+
+        async def fn():
+            clock.advance(3.0)  # each attempt "costs" 3 virtual seconds
+            raise ValueError("slow flake")
+
+        with pytest.raises(RetriesExhaustedError) as ei:
+            await retry(
+                fn,
+                BackoffPolicy(base=0.0001, deadline=5.0),
+                rng=random.Random(0),
+                clock=clock,
+            )
+        # attempt 1 at t=3, attempt 2 would start past the 5s budget
+        assert ei.value.attempts == 2
+
+    @pytest.mark.asyncio
+    async def test_on_retry_callback_sees_errors(self):
+        seen = []
+
+        async def fn():
+            if len(seen) < 2:
+                raise ValueError(f"e{len(seen)}")
+            return 1
+
+        await retry(
+            fn,
+            BackoffPolicy(base=0.0001, max_attempts=10),
+            rng=random.Random(0),
+            on_retry=lambda attempt, err: seen.append((attempt, str(err))),
+        )
+        assert [a for a, _ in seen] == [1, 2]
+
+
+class TestCircuitBreaker:
+    def make(self, **kw) -> tuple[CircuitBreaker, FakeClock]:
+        clock = FakeClock()
+        kw.setdefault("failure_threshold", 3)
+        kw.setdefault("reset_timeout", 10.0)
+        return CircuitBreaker(clock=clock, **kw), clock
+
+    def test_opens_at_threshold(self):
+        br, _ = self.make()
+        for _ in range(2):
+            br.record_failure()
+            assert br.state == "closed" and br.allow()
+        br.record_failure()
+        assert br.state == "open" and not br.allow()
+        assert br.opens == 1
+
+    def test_success_resets_failure_count(self):
+        br, _ = self.make()
+        br.record_failure()
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        br.record_failure()
+        assert br.state == "closed"
+
+    def test_half_open_single_probe(self):
+        br, clock = self.make()
+        for _ in range(3):
+            br.record_failure()
+        clock.advance(10.0)
+        assert br.state == "half-open"
+        assert br.allow()  # claims the only probe slot
+        assert not br.allow()  # no second probe in this window
+        assert br.half_opens == 1
+
+    def test_probe_success_closes(self):
+        br, clock = self.make()
+        for _ in range(3):
+            br.record_failure()
+        clock.advance(10.0)
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed" and br.allow()
+
+    def test_probe_failure_reopens_with_doubled_timeout(self):
+        br, clock = self.make()
+        for _ in range(3):
+            br.record_failure()
+        clock.advance(10.0)
+        assert br.allow()
+        br.record_failure()
+        assert br.state == "open" and br.opens == 2
+        clock.advance(10.0)  # first timeout elapsed, but it doubled to 20
+        assert br.state == "open" and not br.allow()
+        clock.advance(10.0)
+        assert br.state == "half-open"
+
+    def test_reopen_timeout_capped(self):
+        br, clock = self.make(reset_timeout=10.0, max_reset_timeout=15.0)
+        for _ in range(3):
+            br.record_failure()
+        for _ in range(5):  # repeated failed probes keep doubling
+            clock.advance(1000.0)
+            assert br.allow()
+            br.record_failure()
+        clock.advance(15.0)  # capped at max_reset_timeout
+        assert br.state == "half-open"
+
+    def test_straggler_failure_while_open_ignored(self):
+        br, clock = self.make()
+        for _ in range(3):
+            br.record_failure()
+        br.record_failure()  # call that was in flight when the circuit tripped
+        assert br.opens == 1
+        clock.advance(10.0)
+        assert br.state == "half-open"
+
+    def test_guard_context_manager(self):
+        br, clock = self.make(failure_threshold=1)
+        with pytest.raises(ValueError):
+            with br.guard():
+                raise ValueError("boom")
+        assert br.state == "open"
+        with pytest.raises(CircuitOpenError):
+            with br.guard():
+                pass
+        clock.advance(10.0)
+        with br.guard():
+            pass  # half-open probe succeeds
+        assert br.state == "closed"
+
+
+class TestRetryingProvider:
+    """light/provider.py adoption of the shared policy."""
+
+    @pytest.mark.asyncio
+    async def test_transient_errors_retried_then_success(self):
+        from tendermint_tpu.light.provider import ProviderError, RetryingProvider
+
+        class Flaky:
+            def __init__(self):
+                self.calls = 0
+
+            def chain_id(self):
+                return "t"
+
+            async def light_block(self, height):
+                self.calls += 1
+                if self.calls < 3:
+                    raise ProviderError("transient")
+                return f"lb{height}"
+
+            async def report_evidence(self, ev):
+                pass
+
+        inner = Flaky()
+        p = RetryingProvider(
+            inner,
+            policy=BackoffPolicy(base=0.0001, max_attempts=5),
+            rng=random.Random(0),
+        )
+        assert await p.light_block(7) == "lb7"
+        assert inner.calls == 3
+
+    @pytest.mark.asyncio
+    async def test_not_found_is_definitive_and_does_not_trip(self):
+        from tendermint_tpu.light.provider import (
+            LightBlockNotFoundError,
+            RetryingProvider,
+        )
+
+        class Lacking:
+            def __init__(self):
+                self.calls = 0
+
+            def chain_id(self):
+                return "t"
+
+            async def light_block(self, height):
+                self.calls += 1
+                raise LightBlockNotFoundError(str(height))
+
+            async def report_evidence(self, ev):
+                pass
+
+        inner = Lacking()
+        p = RetryingProvider(
+            inner, policy=BackoffPolicy(base=0.0001, max_attempts=5)
+        )
+        for _ in range(6):
+            with pytest.raises(LightBlockNotFoundError):
+                await p.light_block(3)
+        assert inner.calls == 6  # one call each: never retried
+        assert p.breaker.state == "closed"  # and never counted as failure
+
+    @pytest.mark.asyncio
+    async def test_breaker_opens_and_fails_fast(self):
+        from tendermint_tpu.light.provider import ProviderError, RetryingProvider
+
+        class Dead:
+            def __init__(self):
+                self.calls = 0
+
+            def chain_id(self):
+                return "t"
+
+            async def light_block(self, height):
+                self.calls += 1
+                raise ProviderError("down")
+
+            async def report_evidence(self, ev):
+                pass
+
+        inner = Dead()
+        clock = FakeClock()
+        p = RetryingProvider(
+            inner,
+            policy=BackoffPolicy(base=0.0001, max_attempts=2),
+            breaker=CircuitBreaker(
+                failure_threshold=2, reset_timeout=5.0, clock=clock
+            ),
+            rng=random.Random(0),
+        )
+        for _ in range(2):
+            with pytest.raises(ProviderError):
+                await p.light_block(1)
+        assert p.breaker.state == "open"
+        calls_before = inner.calls
+        with pytest.raises(ProviderError):
+            await p.light_block(1)  # fails fast
+        assert inner.calls == calls_before  # inner never touched
+        clock.advance(5.0)  # half-open: the probe reaches the provider
+        with pytest.raises(ProviderError):
+            await p.light_block(1)
+        assert inner.calls > calls_before
