@@ -475,6 +475,106 @@ def bench_blocksync(n_blocks: int, n_vals: int, window: int) -> float:
     return asyncio.run(_bench_blocksync_async(n_blocks, n_vals, window))
 
 
+def bench_verify_hub(
+    n_vals: int, n_submitters: int = 8, per_submitter: int = 200
+) -> dict:
+    """VerifyHub config: N concurrent submitters each feeding
+    SINGLE-vote requests through the sync facade — the live-consensus
+    shape (one vote at a time per caller, concurrency only across
+    callers). Reports coalesced sigs/sec, mean batch occupancy, and the
+    sequential single-vote CPU baseline the hub must beat. Duplicate
+    submissions (the same vote from 'many peers') exercise the dedup
+    cache; throughput is computed over UNIQUE verifications to keep the
+    headline honest."""
+    import queue as _queue
+    import threading as _threading
+
+    from tendermint_tpu import testing as tt
+    from tendermint_tpu.crypto.verify_hub import VerifyHub
+    from tendermint_tpu.types.keys import SignedMsgType
+
+    chain_id = "hub-bench"
+    vals, keys = tt.make_validator_set(min(n_vals, 64), power=10)
+    key_list = [keys[v.address] for v in vals.validators]
+    n_unique = n_submitters * per_submitter
+    items = []
+    for i in range(n_unique):
+        vi = i % len(key_list)
+        bid = tt.make_block_id(b"hub-%d" % (i // len(key_list)))
+        vote = tt.make_vote(
+            chain_id, key_list[vi], vi, 1 + i // len(key_list), 0,
+            SignedMsgType.PREVOTE, bid,
+        )
+        items.append(
+            (vals.validators[vi].pub_key, vote.sign_bytes(chain_id), vote.signature)
+        )
+
+    # sequential single-vote CPU baseline: one verify_signature at a
+    # time, the pre-hub live-consensus path
+    base_n = min(len(items), 400)
+    t0 = time.perf_counter()
+    for pk, msg, sig in items[:base_n]:
+        assert pk.verify_signature(msg, sig)
+    seq_rate = base_n / (time.perf_counter() - t0)
+    log(f"hub bench: sequential single-vote baseline {seq_rate:,.1f} sigs/s")
+
+    hub = VerifyHub(max_batch=256, window_ms=2.0, cache_size=4 * n_unique)
+    hub.start()
+    try:
+        work: _queue.SimpleQueue = _queue.SimpleQueue()
+        for it in items:
+            work.put(it)
+        # a 10% sample re-enters the queue — gossip duplicates for the
+        # cache-hit measurement
+        for d in items[::10]:
+            work.put(d)
+        errors: list = []
+
+        def submitter():
+            while True:
+                try:
+                    pk, msg, sig = work.get_nowait()
+                except _queue.Empty:
+                    return
+                try:
+                    if not hub.verify_sync(pk, msg, sig):
+                        errors.append("bad verdict")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+        threads = [
+            _threading.Thread(target=submitter, name=f"hub-sub-{i}")
+            for i in range(n_submitters)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        assert not errors, errors[:3]
+        s = hub.stats()
+        hub_rate = n_unique / dt
+        out = {
+            "hub_sigs_per_s": round(hub_rate, 1),
+            "sequential_cpu_sigs_per_s": round(seq_rate, 1),
+            "speedup_vs_sequential": round(hub_rate / seq_rate, 2),
+            "mean_batch_occupancy": round(s["mean_occupancy"], 2),
+            "dispatches": int(s["dispatches"]),
+            "cache_hits": int(s["cache_hits"] + s["coalesced"]),
+            "submitters": n_submitters,
+        }
+        log(
+            f"hub bench: {n_unique} unique sigs via {n_submitters} submitters in "
+            f"{dt:.2f}s -> {hub_rate:,.1f} sigs/s (occupancy "
+            f"{out['mean_batch_occupancy']}, {out['dispatches']} dispatches, "
+            f"{out['cache_hits']} cache/coalesce hits)"
+        )
+        return out
+    finally:
+        hub.stop()
+
+
 def main() -> None:
     import numpy as np
 
@@ -609,6 +709,15 @@ def main() -> None:
             log(f"statesync bench failed: {e!r}")
     else:
         log("secondary configs skipped on cpu fallback")
+    # hub config runs on BOTH backends: it measures the scheduler
+    # (coalescing + dedup), which must beat the sequential single-vote
+    # path even on the pure-CPU fallback
+    try:
+        n_sub = int(os.environ.get("TMTPU_BENCH_HUB_SUBMITTERS", "8"))
+        per = 200 if backend != "cpu" else 40
+        extra["verify_hub"] = bench_verify_hub(n_vals, n_sub, per)
+    except Exception as e:  # noqa: BLE001
+        log(f"verify-hub bench failed: {e!r}")
     extra["cpu_multicore_sigs_per_s"] = round(cpu_mt_rate, 1)
 
     print(

@@ -161,11 +161,14 @@ class BlockSyncReactor(Service):
             for height, peer_id in self.pool.next_requests():
                 self._send(m.BlockRequest(height), to=peer_id)
             # peers the pool banned for repeated consecutive timeouts are
-            # evicted for real (fatal PeerError -> router disconnect)
+            # evicted for real (fatal PeerError -> router disconnect) AND
+            # promoted into the peer manager's dial quarantine (ban=True:
+            # escalating cooldown, no redial) — the pool-local timed ban
+            # alone let a bad peer bounce back every BAN_COOLDOWN
             for pid in self.pool.take_banned():
                 self.metrics["peer_bans"] += 1
                 await self.channel.error(
-                    PeerError(pid, "blocksync: repeated request timeouts")
+                    PeerError(pid, "blocksync: repeated request timeouts", ban=True)
                 )
             await asyncio.sleep(REQUEST_INTERVAL)
 

@@ -168,6 +168,7 @@ def _build_node(home: str):
         watchdog_dir=os.path.join(p["data"], "debug") if cfg.rpc.watchdog else "",
         watchdog_threshold_s=cfg.rpc.watchdog_threshold_s,
         chaos=cfg.chaos,
+        verify_hub=cfg.verify_hub,
     )
     transport = TCPTransport(
         send_rate=cfg.p2p.send_rate, recv_rate=cfg.p2p.recv_rate

@@ -112,6 +112,19 @@ class ChaosNetConfig:
 
 
 @dataclass
+class VerifyHubConfig:
+    """VerifyHub — the node-wide micro-batching signature-verification
+    scheduler (crypto/verify_hub.py). Same knobs via TMTPU_VERIFYHUB_*
+    env vars; TMTPU_VERIFYHUB_DISABLE=1 force-bypasses the hub even when
+    `enabled` is true."""
+
+    enabled: bool = True
+    max_batch: int = 512  # dispatch as soon as this many sigs are queued
+    window_ms: float = 2.0  # micro-batch window ceiling (adaptive below it)
+    cache_size: int = 8192  # verified-(pubkey,msg,sig) LRU entries
+
+
+@dataclass
 class StateSyncConfig:
     """Reference config statesync section."""
 
@@ -143,6 +156,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
     chaos: ChaosNetConfig = field(default_factory=ChaosNetConfig)
+    verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
 
 
 def _section_to_toml(name: str, obj) -> str:
@@ -177,6 +191,8 @@ def config_to_toml(cfg: Config) -> str:
         "",
         _section_to_toml("chaos", cfg.chaos),
         "",
+        _section_to_toml("verify_hub", cfg.verify_hub),
+        "",
     ]
     return "\n".join(parts)
 
@@ -199,6 +215,7 @@ def config_from_toml(text: str) -> Config:
         ("statesync", cfg.statesync),
         ("blocksync", cfg.blocksync),
         ("chaos", cfg.chaos),
+        ("verify_hub", cfg.verify_hub),
     ):
         for k, v in data.get(section, {}).items():
             if hasattr(obj, k):

@@ -646,10 +646,14 @@ class ConsensusState(Service):
         proposal.validate_basic()
         if not (-1 <= proposal.pol_round < proposal.round):
             raise ValueError("invalid proposal POL round")
-        # verify proposer signature (state.go:1847)
+        # verify proposer signature (state.go:1847) — via the VerifyHub:
+        # the same proposal gossiped by several peers is answered from
+        # the hub's verdict cache instead of re-verified per peer
+        from ..crypto.verify_hub import verify_one
+
         proposer = rs.validators.get_proposer()
         sb = proposal.sign_bytes(self.state.chain_id)
-        if not proposer.pub_key.verify_signature(sb, proposal.signature):
+        if not verify_one(proposer.pub_key, sb, proposal.signature):
             raise ValueError("invalid proposal signature")
         rs.proposal = proposal
         if rs.proposal_block_parts is None:

@@ -117,6 +117,9 @@ class EvidencePool(EvidencePoolI):
         if ev.timestamp_ns != block_time_ns:
             raise EvidenceError("evidence timestamp != block time")
         chain_id = self.state.chain_id
+        # vote.verify routes through the VerifyHub: the consensus
+        # reactor already verified both votes of a live equivocation, so
+        # these are usually verdict-cache hits, not device work
         for vote in (ev.vote_a, ev.vote_b):
             if not vote.verify(chain_id, val.pub_key):
                 raise EvidenceError("invalid signature on evidence vote")

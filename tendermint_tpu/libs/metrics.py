@@ -189,10 +189,66 @@ class NodeMetrics:
         self.crypto_breaker_probes = r.counter(
             "crypto", "tpu_breaker_probes", "half-open probes routed back to TPU"
         )
+        # verify hub (crypto/verify_hub.py — process-wide scheduler,
+        # folded in at render time like the resilience events)
+        self.verifyhub_dispatches = r.counter(
+            "verifyhub", "dispatches", "micro-batches sent to a verifier"
+        )
+        self.verifyhub_sigs = r.counter(
+            "verifyhub", "sigs_dispatched", "signatures verified via the hub"
+        )
+        self.verifyhub_cache_hits = r.counter(
+            "verifyhub", "cache_hits", "verdicts served from the dedup LRU"
+        )
+        self.verifyhub_coalesced = r.counter(
+            "verifyhub", "coalesced", "requests joined onto an in-flight verify"
+        )
+        self.verifyhub_occupancy = r.gauge(
+            "verifyhub", "batch_occupancy", "mean signatures per dispatch"
+        )
+        self.verifyhub_dispatch_rate = r.gauge(
+            "verifyhub", "dispatch_rate", "dispatches per second since hub start"
+        )
+        self.verifyhub_cache_hit_rate = r.gauge(
+            "verifyhub", "cache_hit_rate", "fraction of requests served from cache"
+        )
+        # bucket layout shared with the hub's live histogram (one source
+        # of truth — _fold_verify_hub copies counts index-for-index)
+        from ..crypto.verify_hub import LATENCY_BUCKETS
+
+        self.verifyhub_queue_latency = r.histogram(
+            "verifyhub",
+            "queue_latency_seconds",
+            "submit-to-dispatch wait per request",
+            buckets=LATENCY_BUCKETS,
+        )
         # abci
         self.abci_latency = r.histogram(
             "abci", "connection_latency_seconds", "app call latency"
         )
+
+    def _fold_verify_hub(self) -> None:
+        from ..crypto.verify_hub import running_hub
+
+        hub = running_hub()
+        if hub is None:
+            return
+        s = hub.stats()
+        self.verifyhub_dispatches._values[()] = s["dispatches"]
+        self.verifyhub_sigs._values[()] = s["dispatched_sigs"]
+        self.verifyhub_cache_hits._values[()] = s["cache_hits"]
+        self.verifyhub_coalesced._values[()] = s["coalesced"]
+        self.verifyhub_occupancy.set(round(s["mean_occupancy"], 3))
+        self.verifyhub_dispatch_rate.set(round(s["dispatch_rate"], 3))
+        self.verifyhub_cache_hit_rate.set(round(s["cache_hit_rate"], 4))
+        # consistent snapshot taken under the hub lock (a mid-copy
+        # dispatch would otherwise skew _count against the bucket sums)
+        counts, sum_, count = hub.latency_snapshot()
+        dst = self.verifyhub_queue_latency
+        if len(counts) == len(dst._counts):  # same LATENCY_BUCKETS layout
+            dst._counts = counts
+            dst._sum = sum_
+            dst._count = count
 
     def render(self) -> str:
         # fold the process-wide resilience events in at scrape time
@@ -200,6 +256,7 @@ class NodeMetrics:
         self.crypto_tpu_fallback_sigs._values[()] = RESILIENCE["tpu_fallback_sigs"]
         self.crypto_breaker_opens._values[()] = RESILIENCE["tpu_breaker_opens"]
         self.crypto_breaker_probes._values[()] = RESILIENCE["tpu_breaker_probes"]
+        self._fold_verify_hub()
         return self.registry.render()
 
 

@@ -10,7 +10,9 @@ Two modes:
     commit (VerifyCommitLight).
 
 Both funnel into the same batched TPU verification path
-(types/validation.py)."""
+(types/validation.py) — and through the VerifyHub when one is running,
+so light-client commits share kernel launches (and the gossip dedup
+cache) with live consensus and block-sync."""
 
 from __future__ import annotations
 

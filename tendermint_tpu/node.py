@@ -18,7 +18,7 @@ from .abci.application import Application
 from .blocksync import BLOCKSYNC_CHANNEL
 from .blocksync import messages as bs_msgs
 from .blocksync.reactor import BlockSyncReactor
-from .config import ConsensusConfig, MempoolConfig
+from .config import ConsensusConfig, MempoolConfig, VerifyHubConfig
 from .consensus import messages as cs_msgs
 from .consensus.reactor import (
     DATA_CHANNEL,
@@ -98,6 +98,10 @@ class NodeConfig:
     # TMTPU_CHAOS_* env vars so any node can run under fault load without
     # code changes.
     chaos: object | None = None
+    # VerifyHub (crypto/verify_hub.py): the node acquires the process
+    # hub on start and releases it on stop; every vote/proposal/commit
+    # signature then routes through the micro-batching scheduler
+    verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
 
 
 class Node(Service):
@@ -255,6 +259,24 @@ class Node(Service):
     # -- lifecycle -------------------------------------------------------
 
     async def on_start(self) -> None:
+        import os
+
+        self.verify_hub = None
+        hub_disabled = os.environ.get("TMTPU_VERIFYHUB_DISABLE", "").lower() not in (
+            "", "0", "false",
+        )
+        if (
+            self.config.verify_hub.enabled
+            and not self.config.seed_mode  # seed nodes verify nothing
+            and not hub_disabled
+        ):
+            from .crypto import verify_hub as vh
+
+            self.verify_hub = vh.acquire_hub(
+                max_batch=self.config.verify_hub.max_batch,
+                window_ms=self.config.verify_hub.window_ms,
+                cache_size=self.config.verify_hub.cache_size,
+            )
         if self.config.watchdog_dir:
             from .libs.watchdog import LoopWatchdog
 
@@ -519,9 +541,21 @@ class Node(Service):
                     await svc.stop()
                 except Exception:
                     pass
-        self.peer_manager.save_addr_book()
-        if not self.config.seed_mode:
-            await self.app_conns.stop()
+        try:
+            self.peer_manager.save_addr_book()
+            if not self.config.seed_mode:
+                await self.app_conns.stop()
+        finally:
+            # refcounted: the hub drains (in-flight verdicts resolve)
+            # and stops only when the LAST in-process node releases it.
+            # In a finally so a teardown error above can't leak the ref
+            # (and with it the dispatcher/runner threads) for the rest
+            # of the process lifetime.
+            if getattr(self, "verify_hub", None) is not None:
+                from .crypto import verify_hub as vh
+
+                vh.release_hub()
+                self.verify_hub = None
 
     # -- convenience -----------------------------------------------------
 

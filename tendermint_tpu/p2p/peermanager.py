@@ -39,6 +39,8 @@ class _PeerInfo:
     connected: bool = False
     inbound: bool = False
     ever_connected: bool = False  # "good" marker persisted in the book
+    bans: int = 0  # promoted bans (PeerError.ban) — escalates the cooldown
+    banned_until: float = 0.0  # dial/accept quarantine expiry (monotonic)
 
 
 class PeerManager:
@@ -153,6 +155,7 @@ class PeerManager:
             for p in self._peers.values()
             if not p.connected
             and p.addresses
+            and now >= p.banned_until
             and now - p.last_dial_failure >= self._retry_delay(p)
         ]
         if not candidates:
@@ -188,6 +191,8 @@ class PeerManager:
         if self.num_connected() >= self.max_connected_upper:
             return False
         info = self._peers.setdefault(node_id, _PeerInfo(node_id))
+        if time.monotonic() < info.banned_until:
+            return False  # quarantined peers can't reconnect inbound either
         if info.connected:
             return False
         info.connected = True
@@ -206,11 +211,42 @@ class PeerManager:
             self._notify(PeerUpdate(node_id, PeerStatus.DOWN))
             self._dial_wake.set()
 
+    # promoted-ban quarantine: first ban sits out BAN_BASE_COOLDOWN,
+    # every repeat doubles it (capped), so a persistently bad peer stops
+    # being redialed while a once-flaky one recovers in minutes
+    BAN_SCORE_PENALTY = 20
+    BAN_BASE_COOLDOWN = 60.0
+    BAN_MAX_COOLDOWN = 3600.0
+
     def errored(self, err: PeerError) -> None:
         info = self._peers.get(err.node_id)
-        if info is not None:
+        if info is None:
+            return
+        if getattr(err, "ban", False):
+            info.bans += 1
+            cooldown = min(
+                self.BAN_BASE_COOLDOWN * (2 ** (info.bans - 1)),
+                self.BAN_MAX_COOLDOWN,
+            )
+            info.banned_until = time.monotonic() + cooldown
+            info.score -= self.BAN_SCORE_PENALTY
+            self.logger.warning(
+                "peer %s banned (%s): quarantine %d of %.0fs (score %d)",
+                err.node_id[:12],
+                err.err,
+                info.bans,
+                cooldown,
+                info.score,
+            )
+        else:
             info.score -= 5
-            self.logger.info("peer %s errored: %s (score %d)", err.node_id[:12], err.err, info.score)
+            self.logger.info(
+                "peer %s errored: %s (score %d)", err.node_id[:12], err.err, info.score
+            )
+
+    def is_banned(self, node_id: NodeID) -> bool:
+        info = self._peers.get(node_id)
+        return info is not None and time.monotonic() < info.banned_until
 
     def evict_candidate(self) -> NodeID | None:
         """Lowest-score connected peer when over capacity."""

@@ -117,3 +117,8 @@ class PeerError(Exception):
     node_id: NodeID
     err: str
     fatal: bool = True  # fatal errors disconnect the peer
+    # ban=True promotes the error into the peer manager's dial
+    # quarantine (escalating cooldown) — e.g. blocksync's
+    # repeated-request-timeout bans, so a persistently bad peer stops
+    # being redialed instead of bouncing through pool-local bans forever
+    ban: bool = False

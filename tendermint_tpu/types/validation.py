@@ -36,6 +36,43 @@ class InvalidCommitError(ValueError):
     pass
 
 
+class _CommitVerifier:
+    """Batch-verifier shim for the verify_commit* funnel: routes the
+    collected signatures through the node's VerifyHub when one is
+    running (cross-subsystem micro-batching + gossip-duplicate dedup),
+    and otherwise through the local `create_batch_verifier` path — the
+    verdicts are identical, the hub only changes where/when the batch
+    launches."""
+
+    def __init__(self, pub_key):
+        self._pub_key = pub_key
+        self._items: list[tuple] = []
+
+    def add(self, pub_key, msg: bytes, sig: bytes) -> None:
+        self._items.append((pub_key, msg, sig))
+
+    def verify(self) -> tuple[bool, list[bool]]:
+        from ..crypto.verify_hub import logger, running_hub
+
+        hub = running_hub()
+        if hub is not None:
+            try:
+                results = hub.verify_many(self._items)
+                return all(results) and bool(results), results
+            except Exception as e:  # noqa: BLE001 — stall/shutdown races
+                # same contract as verify_one: a wedged hub costs
+                # latency, never a spurious commit-verification failure
+                logger.warning(
+                    "hub verify_many failed (%r); verifying %d sigs locally",
+                    e,
+                    len(self._items),
+                )
+        bv = crypto_batch.create_batch_verifier(self._pub_key)
+        for pk, msg, sig in self._items:
+            bv.add(pk, msg, sig)
+        return bv.verify()
+
+
 def _basic_commit_checks(
     vals: ValidatorSet, block_id: BlockID, height: int, commit: Commit
 ) -> None:
@@ -164,7 +201,7 @@ def _iter_entries(vals: ValidatorSet, commit: Commit, lookup_by_index: bool):
 def _verify_batch(
     chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
 ) -> None:
-    bv = crypto_batch.create_batch_verifier(vals.validators[0].pub_key)
+    bv = _CommitVerifier(vals.validators[0].pub_key)
     tallied = 0
     added = 0
     entries = []
@@ -225,7 +262,7 @@ def verify_commit_range(
                 verify_commit_light(chain_id, vals, block_id, height, commit)
                 continue
             if bv is None:
-                bv = crypto_batch.create_batch_verifier(vals.validators[0].pub_key)
+                bv = _CommitVerifier(vals.validators[0].pub_key)
             voting_power_needed = vals.total_voting_power() * 2 // 3
             tallied = 0
             for idx, cs, val in _iter_entries(vals, commit, lookup_by_index=True):
@@ -262,12 +299,14 @@ def verify_commit_range(
 def _verify_single(
     chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
 ) -> None:
+    from ..crypto.verify_hub import verify_one
+
     tallied = 0
     for idx, cs, val in _iter_entries(vals, commit, lookup_by_index):
         if not count_all_signatures and not cs.is_commit():
             continue
-        if not val.pub_key.verify_signature(
-            commit.vote_sign_bytes(chain_id, idx), cs.signature
+        if not verify_one(
+            val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature
         ):
             raise InvalidCommitError(f"invalid signature at index {idx}")
         if cs.is_commit():

@@ -30,10 +30,18 @@ class Vote:
 
     def verify(self, chain_id: str, pub_key) -> bool:
         """Single-vote verification — the consensus per-vote hot path
-        (reference types/vote.go:147)."""
+        (reference types/vote.go:147). Routes through the VerifyHub when
+        one is running. The single-node win here is the verdict CACHE —
+        the same vote arriving from many peers (gossip) verifies once;
+        coalescing into shared batches additionally kicks in whenever
+        other threads/loops are submitting concurrently (commit groups,
+        multi-node processes). This is the adoption point for BOTH
+        VoteSet.add_vote and the evidence pool's vote checks."""
         if pub_key.address() != self.validator_address:
             return False
-        return pub_key.verify_signature(self.sign_bytes(chain_id), self.signature)
+        from ..crypto.verify_hub import verify_one
+
+        return verify_one(pub_key, self.sign_bytes(chain_id), self.signature)
 
     def is_nil(self) -> bool:
         return self.block_id.is_nil()

@@ -161,6 +161,50 @@ class TestPeerManager:
         pm.errored(PeerError("p1", "bad vote"))
         assert pm._peers["p1"].score < 1
 
+    def test_ban_promotion_quarantines_dialing(self, monkeypatch):
+        """A ban-flagged PeerError (blocksync repeated-timeout bans)
+        promotes into a dial quarantine with escalating cooldown — the
+        peer is neither redialed nor re-accepted until it expires."""
+        import time as _time
+
+        now = {"t": 1000.0}
+        monkeypatch.setattr(_time, "monotonic", lambda: now["t"])
+        pm = PeerManager("self")
+        addr = NodeAddress(node_id="badpeer", protocol="memory")
+        pm.add_address(addr)
+        assert pm.try_dial_next() == addr
+
+        pm.connected("badpeer", inbound=False)
+        pm.errored(PeerError("badpeer", "blocksync: repeated request timeouts", ban=True))
+        pm.disconnected("badpeer")
+        assert pm.is_banned("badpeer")
+        assert pm.try_dial_next() is None  # quarantined: no redial
+        assert not pm.connected("badpeer", inbound=True)  # nor inbound
+        info = pm._peers["badpeer"]
+        # connected() granted +1 before the ban's -20 landed
+        assert info.bans == 1 and info.score <= 1 - PeerManager.BAN_SCORE_PENALTY
+
+        # cooldown expires -> dialable again
+        now["t"] += PeerManager.BAN_BASE_COOLDOWN + 1
+        assert not pm.is_banned("badpeer")
+        assert pm.try_dial_next() == addr
+
+        # second ban doubles the quarantine
+        pm.connected("badpeer", inbound=False)
+        pm.errored(PeerError("badpeer", "again", ban=True))
+        pm.disconnected("badpeer")
+        now["t"] += PeerManager.BAN_BASE_COOLDOWN + 1
+        assert pm.is_banned("badpeer")  # 2x cooldown still running
+        now["t"] += PeerManager.BAN_BASE_COOLDOWN
+        assert not pm.is_banned("badpeer")
+
+    def test_non_ban_error_does_not_quarantine(self):
+        pm = PeerManager("self")
+        pm.add_address(NodeAddress(node_id="p1", protocol="memory"))
+        pm.errored(PeerError("p1", "malformed message"))
+        assert not pm.is_banned("p1")
+        assert pm.try_dial_next() is not None
+
 
 class TestRouterNetwork:
     @pytest.mark.asyncio
