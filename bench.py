@@ -475,6 +475,67 @@ def bench_blocksync(n_blocks: int, n_vals: int, window: int) -> float:
     return asyncio.run(_bench_blocksync_async(n_blocks, n_vals, window))
 
 
+def bench_crash_recovery(n_heights: int = 400, msgs_per_height: int = 20) -> dict:
+    """crash_recovery config: WAL replay throughput after a seeded crash.
+    Build a WAL of `n_heights` heights (message records + fsync'd
+    end-height markers) through the chaos-fs layer, tear the un-fsynced
+    tail mid-record at a simulated crash, then measure (a) the open-time
+    repair (truncate to the last whole record, rotate damaged tail
+    aside) and (b) replay rate in heights/sec and records/sec — the
+    downtime a validator spends between restart and first vote."""
+    import shutil
+    import tempfile
+    import time as _t
+
+    from tendermint_tpu.consensus.wal import KIND_END_HEIGHT, WAL
+    from tendermint_tpu.libs.chaosfs import ChaosFS, ChaosFSConfig
+
+    d = tempfile.mkdtemp(prefix="benchwal-")
+    try:
+        fs = ChaosFS(ChaosFSConfig(seed=9, torn_write_rate=1.0))
+        wal = WAL(d, fs=fs)
+        payload = b"\x12\x40" + b"\xab" * 126  # ~128B opaque consensus msg
+        for h in range(1, n_heights + 1):
+            for _ in range(msgs_per_height):
+                wal.write(payload)
+            wal.write_end_height(h)  # fsync: the durable watermark
+        for _ in range(msgs_per_height):
+            wal.write(payload)  # un-fsynced tail, torn by the crash
+        fs.halt()
+        wal.close()
+        fs.simulate_crash()
+
+        t0 = _t.perf_counter()
+        wal2 = WAL(d, fs=fs)  # open-time repair
+        repair_dt = _t.perf_counter() - t0
+        t0 = _t.perf_counter()
+        n_recs = heights = 0
+        for rec in wal2.iter_records():
+            n_recs += 1
+            if rec.kind == KIND_END_HEIGHT:
+                heights = rec.height
+        replay_dt = _t.perf_counter() - t0
+        wal2.close()
+        out = {
+            "replay_heights_per_s": round(heights / replay_dt, 1),
+            "replay_records_per_s": round(n_recs / replay_dt, 1),
+            "repair_ms": round(repair_dt * 1e3, 2),
+            "repaired_files": len(wal2.last_repair),
+            "heights": heights,
+            "records": n_recs,
+        }
+        log(
+            f"crash recovery: repaired {out['repaired_files']} file(s) in "
+            f"{out['repair_ms']}ms, replayed {heights} heights "
+            f"({n_recs} records) in {replay_dt:.3f}s -> "
+            f"{out['replay_heights_per_s']:,.1f} heights/s"
+        )
+        assert heights == n_heights, (heights, n_heights)
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_verify_hub(
     n_vals: int, n_submitters: int = 8, per_submitter: int = 200
 ) -> dict:
@@ -718,6 +779,12 @@ def main() -> None:
         extra["verify_hub"] = bench_verify_hub(n_vals, n_sub, per)
     except Exception as e:  # noqa: BLE001
         log(f"verify-hub bench failed: {e!r}")
+    # crash_recovery runs on BOTH backends: WAL repair + replay is pure
+    # host work, and recovery downtime is a headline robustness number
+    try:
+        extra["crash_recovery"] = bench_crash_recovery()
+    except Exception as e:  # noqa: BLE001
+        log(f"crash-recovery bench failed: {e!r}")
     extra["cpu_multicore_sigs_per_s"] = round(cpu_mt_rate, 1)
 
     print(

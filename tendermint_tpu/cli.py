@@ -168,6 +168,7 @@ def _build_node(home: str):
         watchdog_dir=os.path.join(p["data"], "debug") if cfg.rpc.watchdog else "",
         watchdog_threshold_s=cfg.rpc.watchdog_threshold_s,
         chaos=cfg.chaos,
+        chaos_fs=cfg.chaos_fs,
         verify_hub=cfg.verify_hub,
     )
     transport = TCPTransport(

@@ -109,6 +109,27 @@ class ChaosNetConfig:
     duplicate_rate: float = 0.0
     reorder_rate: float = 0.0
     corrupt_rate: float = 0.0
+    bandwidth_rate: float = 0.0  # per-link cap, bytes/sec (queue buildup)
+    gray_delay_ms: float = 0.0  # gray failure: fixed slow-but-alive delay
+    clock_skew_ms: float = 0.0  # max |per-validator clock skew|
+    clock_drift: float = 0.0  # max |rate error| (timeouts fire early/late)
+
+
+@dataclass
+class ChaosFSConfig:
+    """Chaos-fs storage fault injection (libs/chaosfs.py). Off by
+    default; when `enabled`, the node's WAL rides the seeded
+    fault-injecting FS and the block/state DBs are wrapped in `ChaosDB`.
+    Env mirror: TMTPU_CHAOS_FS_* (libs/chaosfs.py docstring)."""
+
+    enabled: bool = False
+    seed: int = 0
+    torn_write_rate: float = 0.0  # P(crash leaves a partial, mid-record tail)
+    torn_offset: int = -1  # fixed tear offset into the un-fsynced tail
+    lost_fsync_rate: float = 0.0  # P(fsync acked but not durable)
+    enospc_rate: float = 0.0  # P(write fails ENOSPC mid-record)
+    enospc_at_byte: int = -1  # arm ENOSPC at an exact cumulative byte
+    bitrot_rate: float = 0.0  # P(read returns one flipped byte)
 
 
 @dataclass
@@ -156,6 +177,7 @@ class Config:
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
     blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
     chaos: ChaosNetConfig = field(default_factory=ChaosNetConfig)
+    chaos_fs: ChaosFSConfig = field(default_factory=ChaosFSConfig)
     verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
 
 
@@ -191,6 +213,8 @@ def config_to_toml(cfg: Config) -> str:
         "",
         _section_to_toml("chaos", cfg.chaos),
         "",
+        _section_to_toml("chaos_fs", cfg.chaos_fs),
+        "",
         _section_to_toml("verify_hub", cfg.verify_hub),
         "",
     ]
@@ -215,6 +239,7 @@ def config_from_toml(text: str) -> Config:
         ("statesync", cfg.statesync),
         ("blocksync", cfg.blocksync),
         ("chaos", cfg.chaos),
+        ("chaos_fs", cfg.chaos_fs),
         ("verify_hub", cfg.verify_hub),
     ):
         for k, v in data.get(section, {}).items():

@@ -32,6 +32,15 @@ from ..types.genesis import GenesisDoc, GenesisValidator
 MS = 1_000_000
 
 
+async def _deliver_after(delay: float, coro) -> None:
+    try:
+        await asyncio.sleep(delay)
+    except asyncio.CancelledError:
+        coro.close()  # net.stop() mid-delay: don't leak an un-awaited coro
+        raise
+    await coro
+
+
 def fast_config() -> ConsensusConfig:
     """Short timeouts so multi-round tests finish quickly."""
     return ConsensusConfig(
@@ -71,6 +80,8 @@ class Node:
         config: ConsensusConfig | None = None,
         wal_dir: str | None = None,
         app=None,
+        fs=None,  # libs/chaosfs.FS — storage fault injection for the WAL
+        clock=None,  # libs/clock.Clock — injectable consensus time
     ):
         self.genesis = genesis
         self.config = config or fast_config()
@@ -80,7 +91,8 @@ class Node:
         self.state_store = StateStore(MemDB())
         self.event_bus = EventBus()
         self.priv_val = MockPV(priv_key) if priv_key is not None else None
-        self.wal = WAL(wal_dir or tempfile.mkdtemp(prefix="cswal-"))
+        self.clock = clock
+        self.wal = WAL(wal_dir or tempfile.mkdtemp(prefix="cswal-"), fs=fs)
         self.mempool: PriorityMempool | None = None
         self.evidence_pool: EvidencePool | None = None
         self.cs: ConsensusState | None = None
@@ -118,6 +130,7 @@ class Node:
             wal=self.wal,
             event_bus=self.event_bus,
             mempool=self.mempool,
+            clock=self.clock,
         )
         await self.cs.start()
 
@@ -129,12 +142,40 @@ class Node:
 
 class LocalNetwork:
     """N validator nodes with broadcast hooks delivering every outbound
-    consensus message to every other node's peer queue."""
+    consensus message to every other node's peer queue.
 
-    def __init__(self, n_vals: int, *, config: ConsensusConfig | None = None):
+    `chaos` (libs/chaos.ChaosNetwork) threads the fault plan under the
+    hook wiring — drops, asymmetric partitions, delays, reorders, and
+    duplicates apply per (sender→receiver) link; node ids are
+    "node0".."nodeN-1". Corruption and bandwidth shaping are
+    byte-stream faults the typed-message hooks cannot model — use the
+    real router + ChaosTransport (tests/chaos_net.py) for those; don't
+    set their rates here, or the fault counters will report injections
+    the hook never performed. When the chaos config carries
+    `clock_skew_ms`, each validator runs on its own deterministically
+    skewed clock (over `base_clock` if given — a frozen `ManualClock`
+    base makes the whole run's vote/block timestamps
+    bit-reproducible)."""
+
+    def __init__(
+        self,
+        n_vals: int,
+        *,
+        config: ConsensusConfig | None = None,
+        chaos=None,
+        base_clock=None,
+    ):
         self.genesis, self.keys = make_genesis(n_vals)
+        self.chaos = chaos
+        clocks = [base_clock] * n_vals
+        if chaos is not None:
+            clocks = [
+                chaos.clock_for(f"node{i}", base=base_clock)
+                for i in range(n_vals)
+            ]
         self.nodes = [
-            Node(self.genesis, k, config=config) for k in self.keys
+            Node(self.genesis, k, config=config, clock=clocks[i])
+            for i, k in enumerate(self.keys)
         ]
         self._tasks: list[asyncio.Task] = []
 
@@ -153,8 +194,22 @@ class LocalNetwork:
                 if mi is None:
                     continue
                 kind, args = mi
-                coro = getattr(other.cs, kind)(*args, f"node{sender}")
-                self._tasks.append(asyncio.get_running_loop().create_task(coro))
+                delay, copies = 0.0, 1
+                if self.chaos is not None:
+                    plan = self.chaos.plan(f"node{sender}", f"node{j}", 0)
+                    if plan.drop:
+                        continue
+                    # reorder = extra delay pushing past successors, as
+                    # in ChaosConnection.send_message
+                    delay = plan.delay_s + (0.05 if plan.reorder else 0.0)
+                    copies = 2 if plan.duplicate else 1
+                for _ in range(copies):
+                    coro = getattr(other.cs, kind)(*args, f"node{sender}")
+                    if delay > 0:
+                        coro = _deliver_after(delay, coro)
+                    self._tasks.append(
+                        asyncio.get_running_loop().create_task(coro)
+                    )
 
         return hook
 

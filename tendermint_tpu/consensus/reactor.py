@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
 from ..libs.service import Service
 from ..p2p.peermanager import PeerStatus
@@ -88,7 +87,7 @@ class ConsensusReactor(Service):
             round=rs.round,
             step=int(rs.step),
             seconds_since_start_time=max(
-                0, int((time.time_ns() - rs.start_time_ns) / 1e9)
+                0, int((self.cs.clock.now_ns() - rs.start_time_ns) / 1e9)
             ),
             last_commit_round=rs.last_commit.round if rs.last_commit else -1,
         )

@@ -31,6 +31,7 @@ from typing import Callable
 
 from ..config import ConsensusConfig
 from ..evidence import EvidencePoolI, NopEvidencePool
+from ..libs.clock import SYSTEM, Clock
 from ..libs.service import Service
 from ..privval import PrivValidator
 from ..state.execution import BlockExecutor
@@ -52,10 +53,6 @@ from . import messages as m
 from .ticker import TimeoutInfo, TimeoutTicker
 from .types import HeightVoteSet, RoundState, RoundStep
 from .wal import WAL, KIND_END_HEIGHT, KIND_MESSAGE
-
-
-def _now_ns() -> int:
-    return time.time_ns()
 
 
 @dataclass(frozen=True)
@@ -85,10 +82,16 @@ class ConsensusState(Service):
         wal: WAL | None = None,
         event_bus: EventBus | None = None,
         mempool=None,
+        clock: Clock | None = None,
         logger: logging.Logger | None = None,
     ):
         super().__init__("consensus", logger)
         self.config = config
+        # injectable time source: every wall-clock reading the SM stamps
+        # into protocol output (vote/proposal times, commit_time, the
+        # NewHeight schedule) goes through this, so chaos runs can freeze
+        # or skew it per validator (libs/clock.py)
+        self.clock = clock or SYSTEM
         self.block_exec = block_exec
         self.block_store = block_store
         self.mempool = mempool
@@ -108,7 +111,7 @@ class ConsensusState(Service):
         self.msg_queue: asyncio.Queue[MsgInfo | TimeoutInfo] = asyncio.Queue(
             maxsize=2000
         )
-        self.ticker = TimeoutTicker(self.msg_queue)
+        self.ticker = TimeoutTicker(self.msg_queue, clock=self.clock)
 
         # reactor hooks: called with consensus Messages to gossip out
         self.broadcast_hook: Callable[[object], None] | None = None
@@ -203,7 +206,7 @@ class ConsensusState(Service):
         rs.round = 0
         rs.step = RoundStep.NEW_HEIGHT
         if rs.commit_time_ns == 0:
-            rs.start_time_ns = self.config.commit_time_ns(_now_ns())
+            rs.start_time_ns = self.config.commit_time_ns(self.clock.now_ns())
         else:
             rs.start_time_ns = self.config.commit_time_ns(rs.commit_time_ns)
         rs.validators = validators
@@ -437,7 +440,7 @@ class ConsensusState(Service):
             # the configured inter-block cadence, not tx-arrival + full
             # commit timeout
             self._schedule_timeout(
-                max(0, rs.start_time_ns - _now_ns()),
+                max(0, rs.start_time_ns - self.clock.now_ns()),
                 rs.height,
                 0,
                 RoundStep.NEW_ROUND,
@@ -609,7 +612,7 @@ class ConsensusState(Service):
                 return
 
         block_id = BlockID(block.hash(), parts.header)
-        proposal = Proposal(height, round_, rs.valid_round, block_id, _now_ns())
+        proposal = Proposal(height, round_, rs.valid_round, block_id, self.clock.now_ns())
 
         def on_signed(signed: Proposal) -> None:
             self._send_internal(MsgInfo(m.ProposalMessage(signed)))
@@ -868,7 +871,7 @@ class ConsensusState(Service):
         self.logger.debug("enterCommit %d/%d", height, commit_round)
         rs.step = RoundStep.COMMIT
         rs.commit_round = commit_round
-        rs.commit_time_ns = _now_ns()
+        rs.commit_time_ns = self.clock.now_ns()
         self._new_step()
 
         precommits = rs.votes.precommits(commit_round)
@@ -925,12 +928,12 @@ class ConsensusState(Service):
         state, _ = await self.block_exec.apply_block(self.state, block_id, block)
 
         # next height
-        rs.commit_time_ns = _now_ns()
+        rs.commit_time_ns = self.clock.now_ns()
         self.update_to_state(state)
         self._decided.set()
         self._decided = asyncio.Event()
         self._schedule_timeout(
-            max(0, rs.start_time_ns - _now_ns()),
+            max(0, rs.start_time_ns - self.clock.now_ns()),
             rs.height,
             0,
             RoundStep.NEW_HEIGHT,
@@ -1106,7 +1109,7 @@ class ConsensusState(Service):
     def _vote_time_ns(self) -> int:
         """Monotonic vote time ≥ last block time + 1ms (reference
         voteTime state.go:2237)."""
-        now = _now_ns()
+        now = self.clock.now_ns()
         minimum = 0
         if self.rs.locked_block is not None:
             minimum = self.rs.locked_block.header.time_ns + 1_000_000

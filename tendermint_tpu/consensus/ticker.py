@@ -11,6 +11,7 @@ from __future__ import annotations
 import asyncio
 from dataclasses import dataclass
 
+from ..libs.clock import SYSTEM, Clock
 from .types import RoundStep
 
 
@@ -26,10 +27,14 @@ class TimeoutInfo:
 
 
 class TimeoutTicker:
-    def __init__(self, tock: "asyncio.Queue | None" = None):
+    def __init__(self, tock: "asyncio.Queue | None" = None, clock: Clock | None = None):
         # fired timeouts are delivered here; the consensus SM passes its
-        # merged input queue
+        # merged input queue. The clock scales timeout durations: a
+        # drifting validator (libs/clock.SkewedClock with rate != 1)
+        # fires its consensus timeouts early/late, which is exactly the
+        # fault the chaos clock-skew class wants to exercise.
         self.tock: asyncio.Queue = tock if tock is not None else asyncio.Queue()
+        self.clock = clock or SYSTEM
         self._pending: TimeoutInfo | None = None
         self._timer: asyncio.TimerHandle | None = None
 
@@ -42,7 +47,7 @@ class TimeoutTicker:
         self._cancel()
         self._pending = ti
         loop = asyncio.get_running_loop()
-        self._timer = loop.call_later(ti.duration_ns / 1e9, self._fire, ti)
+        self._timer = loop.call_later(self.clock.timeout_s(ti.duration_ns), self._fire, ti)
 
     def _fire(self, ti: TimeoutInfo) -> None:
         if self._pending is ti:

@@ -8,10 +8,21 @@ EndHeightMessage).
 
 Files: `wal` is the head; at `head_size_limit` it rotates to `wal.000`,
 `wal.001`, … (the autofile.Group analog); replay reads rotated files in
-order, then the head."""
+order, then the head.
+
+Crash consistency: all file I/O goes through an injectable `libs.chaosfs.FS`
+(lint-enforced by scripts/check_fs_callsites.py) so storage faults — torn
+writes, lost fsyncs, ENOSPC mid-record, bit-rot — are testable. On open,
+`repair()` scans every file and truncates to the last whole record,
+moving any damaged tail aside into `<file>.corrupt.<n>` instead of
+raising: a node killed mid-write restarts without manual intervention,
+and the exact truncation point is logged (consensus/replay.py
+`report_wal_repair`). A mid-record ENOSPC rolls the partial frame back so
+the log never grows an undetected garbage gap."""
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 import zlib
@@ -19,6 +30,8 @@ from dataclasses import dataclass
 from typing import Iterator
 
 from ..libs import protoenc as pe
+from ..libs.chaosfs import FS, REAL_FS
+from ..libs.metrics import record_storage
 
 _FRAME = struct.Struct("<II")  # crc32, length
 MAX_RECORD_SIZE = 1 << 20
@@ -66,6 +79,18 @@ class WALCorruptionError(RuntimeError):
     pass
 
 
+@dataclass(frozen=True)
+class WALRepair:
+    """One repaired file: everything past `valid_end` was moved aside."""
+
+    path: str
+    valid_end: int  # byte offset of the last whole record
+    file_size: int  # size before repair
+    n_records: int  # whole records surviving in this file
+    tail_path: str  # where the damaged tail went
+    reason: str  # what broke the frame walk
+
+
 class WAL:
     def __init__(
         self,
@@ -73,13 +98,21 @@ class WAL:
         *,
         head_size_limit: int = 10 * 1024 * 1024,
         total_size_limit: int = 1024 * 1024 * 1024,
+        fs: FS | None = None,
+        logger: logging.Logger | None = None,
     ):
         self.dir = directory
         self.head_size_limit = head_size_limit
         self.total_size_limit = total_size_limit
-        os.makedirs(directory, exist_ok=True)
+        self.fs = fs or REAL_FS
+        self.logger = logger or logging.getLogger("wal")
+        self.fs.makedirs(directory)
         self._head_path = os.path.join(directory, "wal")
-        self._f = open(self._head_path, "ab")
+        # heal any crash damage BEFORE appending: writing after a torn
+        # tail would bury the corruption mid-file and silently drop every
+        # later record at replay
+        self.last_repair: list[WALRepair] = self.repair()
+        self._f = self.fs.open(self._head_path, "ab")
 
     # -- writing ---------------------------------------------------------
 
@@ -88,10 +121,26 @@ class WAL:
         if len(payload) > MAX_RECORD_SIZE:
             raise ValueError("WAL record too big")
         frame = _FRAME.pack(zlib.crc32(payload), len(payload))
-        self._f.write(frame + payload)
+        start = self._f.tell()
+        try:
+            self._f.write(frame + payload)
+        except OSError:
+            # ENOSPC (or any I/O error) mid-record: roll the partial frame
+            # back so the head never grows an unframed garbage gap. Best
+            # effort — if even the truncate fails, repair() heals it at
+            # the next open.
+            try:
+                self._f.flush()
+            except OSError:
+                pass
+            try:
+                self._f.truncate(start)
+                self._f.seek(start)
+            except OSError:
+                pass
+            raise
         if sync:
-            self._f.flush()
-            os.fsync(self._f.fileno())
+            self.fs.fsync(self._f)
         if self._f.tell() >= self.head_size_limit:
             self._rotate()
 
@@ -108,8 +157,7 @@ class WAL:
         self._write_record(WALRecord(KIND_END_HEIGHT, 0, b"", height), sync=True)
 
     def flush(self) -> None:
-        self._f.flush()
-        os.fsync(self._f.fileno())
+        self.fs.fsync(self._f)
 
     def close(self) -> None:
         try:
@@ -122,7 +170,7 @@ class WAL:
 
     def _rotated_files(self) -> list[str]:
         names = sorted(
-            (n for n in os.listdir(self.dir) if n.startswith("wal.") and n[4:].isdigit()),
+            (n for n in self.fs.listdir(self.dir) if n.startswith("wal.") and n[4:].isdigit()),
             key=lambda n: int(n[4:]),
         )
         return [os.path.join(self.dir, n) for n in names]
@@ -134,54 +182,158 @@ class WAL:
         idx = (
             int(os.path.basename(existing[-1])[4:]) + 1 if existing else 0
         )
-        os.rename(self._head_path, os.path.join(self.dir, f"wal.{idx:03d}"))
-        self._f = open(self._head_path, "ab")
+        self.fs.rename(self._head_path, os.path.join(self.dir, f"wal.{idx:03d}"))
+        self._f = self.fs.open(self._head_path, "ab")
         # enforce the group size cap by dropping the oldest rotated file
         files = self._rotated_files()
-        total = sum(os.path.getsize(p) for p in files) + self._f.tell()
+        total = sum(self.fs.getsize(p) for p in files) + self._f.tell()
         while files and total > self.total_size_limit:
-            total -= os.path.getsize(files[0])
-            os.remove(files.pop(0))
+            total -= self.fs.getsize(files[0])
+            self.fs.remove(files.pop(0))
 
     # -- reading ---------------------------------------------------------
 
     def _all_files(self) -> list[str]:
         files = self._rotated_files()
-        if os.path.exists(self._head_path):
+        if self.fs.exists(self._head_path):
             files.append(self._head_path)
         return files
+
+    def _note_corrupt(self, path: str, offset: int, reason: str) -> None:
+        record_storage("wal_corrupt_records")
+        self.logger.warning(
+            "WAL corruption: %s in %s at offset %d (replay truncated here)",
+            reason, path, offset,
+        )
 
     def iter_records(self, *, strict: bool = False) -> Iterator[WALRecord]:
         """Replay all records oldest-first. A torn tail frame (crash during
         write) terminates iteration; corruption mid-log raises in strict
-        mode (reference WALDecoder semantics)."""
-        self._f.flush()
+        mode (reference WALDecoder semantics). Non-strict truncation is
+        never silent: it bumps the `wal_corrupt_records` metric and logs
+        the file/offset."""
+        if getattr(self, "_f", None) is not None and not self._f.closed:
+            self._f.flush()
         for path in self._all_files():
-            with open(path, "rb") as f:
+            with self.fs.open(path, "rb") as f:
                 is_head = path == self._head_path
                 while True:
+                    at = f.tell()
                     frame = f.read(_FRAME.size)
                     if not frame:
                         break
                     if len(frame) < _FRAME.size:
                         if strict and not is_head:
                             raise WALCorruptionError(f"torn frame in {path}")
+                        self._note_corrupt(path, at, "torn frame")
                         return
                     crc, length = _FRAME.unpack(frame)
                     if length > MAX_RECORD_SIZE:
                         if strict:
                             raise WALCorruptionError(f"oversized record in {path}")
+                        self._note_corrupt(path, at, "oversized record")
                         return
                     payload = f.read(length)
                     if len(payload) < length:
                         if strict and not is_head:
                             raise WALCorruptionError(f"torn payload in {path}")
+                        self._note_corrupt(path, at, "torn payload")
                         return
                     if zlib.crc32(payload) != crc:
                         if strict:
                             raise WALCorruptionError(f"CRC mismatch in {path}")
+                        self._note_corrupt(path, at, "CRC mismatch")
                         return
                     yield WALRecord.decode(payload)
+
+    # -- crash repair ----------------------------------------------------
+
+    def _scan_valid(self, path: str) -> tuple[int, int, str]:
+        """Walk frames; return (offset past the last whole record, count
+        of whole records, reason the walk stopped)."""
+        valid_end = 0
+        n = 0
+        with self.fs.open(path, "rb") as f:
+            while True:
+                frame = f.read(_FRAME.size)
+                if not frame:
+                    return valid_end, n, "eof"
+                if len(frame) < _FRAME.size:
+                    return valid_end, n, "torn frame"
+                crc, length = _FRAME.unpack(frame)
+                if length > MAX_RECORD_SIZE:
+                    return valid_end, n, "oversized record"
+                payload = f.read(length)
+                if len(payload) < length:
+                    return valid_end, n, "torn payload"
+                if zlib.crc32(payload) != crc:
+                    return valid_end, n, "CRC mismatch"
+                valid_end = f.tell()
+                n += 1
+
+    def repair(self) -> list[WALRepair]:
+        """Truncate every WAL file to its last whole record, moving the
+        damaged tail aside as `<file>.corrupt.<n>` (never deleted — it is
+        forensic evidence, and `wal.corrupt.*` names are invisible to the
+        rotation scan). ALL files are scanned, not just the newest:
+        lost-but-acked fsyncs mean even rotated files can carry torn
+        tails (the durable watermark travels with the rename), and an
+        unrepaired mid-log tear would silently drop every later record
+        at replay. The cost is one extra CRC pass over the WAL — the
+        same order as the `iter_records` replay that follows on every
+        restart anyway. Returns one `WALRepair` per healed file; the
+        caller (consensus/replay.report_wal_repair) logs the truncation
+        points."""
+        repairs: list[WALRepair] = []
+        for path in self._all_files():
+            size = self.fs.getsize(path)
+            valid_end, n, reason = self._scan_valid(path)
+            if valid_end >= size:
+                continue
+            # confirm before destroying: a transient read error (bit-rot
+            # injection, flaky medium) must not truncate records that are
+            # intact on disk — re-scan and keep the furthest clean walk
+            valid_end2, n2, reason2 = self._scan_valid(path)
+            if valid_end2 >= size:
+                continue  # first scan's corruption was a transient read
+            if valid_end2 > valid_end:
+                valid_end, n, reason = valid_end2, n2, reason2
+            # salvage the damaged tail before truncating — best-effort:
+            # it is forensic evidence, and a full disk (ENOSPC) must not
+            # turn a post-crash restart into a startup failure
+            k = 0
+            while self.fs.exists(f"{path}.corrupt.{k}"):
+                k += 1
+            tail_path = f"{path}.corrupt.{k}"
+            try:
+                with self.fs.open(path, "rb") as src:
+                    src.seek(valid_end)
+                    tail = src.read(size - valid_end)
+                with self.fs.open(tail_path, "wb") as dst:
+                    dst.write(tail)
+                    self.fs.fsync(dst)
+            except OSError as e:
+                self.logger.warning(
+                    "WAL repair: could not salvage damaged tail of %s "
+                    "to %s (%r); truncating anyway", path, tail_path, e,
+                )
+                try:
+                    if self.fs.exists(tail_path):
+                        self.fs.remove(tail_path)  # no partial salvage litter
+                except OSError:
+                    pass
+                tail_path = ""
+            self.fs.truncate(path, valid_end)
+            record_storage("wal_repairs")
+            record_storage("wal_truncated_bytes", size - valid_end)
+            rep = WALRepair(path, valid_end, size, n, tail_path, reason)
+            repairs.append(rep)
+            self.logger.warning(
+                "WAL repair: %s at %s:%d — truncated %d damaged byte(s) to "
+                "the last whole record (#%d), tail saved to %s",
+                reason, path, valid_end, size - valid_end, n, tail_path,
+            )
+        return repairs
 
     def search_for_end_height(self, height: int) -> list[WALRecord] | None:
         """Messages recorded after `#ENDHEIGHT: height` (reference

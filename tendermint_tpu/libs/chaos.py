@@ -24,7 +24,22 @@ Config surface (env mirrors `config.ChaosConfig`):
   TMTPU_CHAOS_REORDER    float   reorder probability (delays one msg past
                                  its successor)
   TMTPU_CHAOS_CORRUPT    float   payload bit-flip probability
-"""
+  TMTPU_CHAOS_BW         float   per-link bandwidth cap, bytes/sec — a
+                                 leaky-bucket queue whose backlog turns
+                                 into delivery delay (queue buildup)
+  TMTPU_CHAOS_GRAY_MS    float   gray failure: fixed per-message delay
+                                 (slow-but-alive, tuned to sit just under
+                                 timeout thresholds)
+  TMTPU_CHAOS_SKEW_MS    float   max |clock skew| per validator; each
+                                 node's offset is drawn deterministically
+                                 from (seed, node_id) — see `clock_for`
+  TMTPU_CHAOS_DRIFT      float   max |oscillator rate error| per
+                                 validator (0.05 = up to 5% fast/slow;
+                                 consensus timeouts fire early/late)
+
+Beyond the symmetric `partition()`, `partition_oneway(src, dst)` models
+asymmetric reachability: src→dst traffic drops while dst→src flows (the
+half-open links real WAN partitions produce)."""
 
 from __future__ import annotations
 
@@ -46,6 +61,19 @@ class ChaosConfig:
     duplicate_rate: float = 0.0
     reorder_rate: float = 0.0
     corrupt_rate: float = 0.0
+    # per-link bandwidth cap in BYTES/sec (0 = unlimited): messages queue
+    # behind each other on the link and backlog becomes delivery delay
+    bandwidth_rate: float = 0.0
+    # gray failure: a fixed delay on EVERY message (slow-but-alive; pick a
+    # value just under the consumer's timeout to model the worst kind of
+    # sick peer — one that never looks dead)
+    gray_delay_ms: float = 0.0
+    # max |per-validator clock skew| handed out by `ChaosNetwork.clock_for`
+    clock_skew_ms: float = 0.0
+    # max |per-validator oscillator drift| (rate error): 0.05 means each
+    # validator's clock runs up to 5% fast/slow, so its consensus
+    # timeouts fire early/late through the ticker's duration scaling
+    clock_drift: float = 0.0
     # channel_id -> rate overrides, e.g. {0x40: ChaosConfig(drop_rate=0.5)}
     per_channel: dict = field(default_factory=dict)
 
@@ -62,6 +90,10 @@ class ChaosConfig:
             duplicate_rate=f("TMTPU_CHAOS_DUP"),
             reorder_rate=f("TMTPU_CHAOS_REORDER"),
             corrupt_rate=f("TMTPU_CHAOS_CORRUPT"),
+            bandwidth_rate=f("TMTPU_CHAOS_BW"),
+            gray_delay_ms=f("TMTPU_CHAOS_GRAY_MS"),
+            clock_skew_ms=f("TMTPU_CHAOS_SKEW_MS"),
+            clock_drift=f("TMTPU_CHAOS_DRIFT"),
         )
 
     def enabled(self) -> bool:
@@ -72,6 +104,10 @@ class ChaosConfig:
                 self.duplicate_rate,
                 self.reorder_rate,
                 self.corrupt_rate,
+                self.bandwidth_rate,
+                self.gray_delay_ms,
+                self.clock_skew_ms,
+                self.clock_drift,
                 self.per_channel,
             )
         )
@@ -97,12 +133,17 @@ class ChaosNetwork:
         self.config = config or ChaosConfig()
         self.rng = random.Random(self.config.seed)
         self._groups: list[set[str]] = []
+        self._oneway: list[tuple[set[str], set[str]]] = []  # (src, dst) blocked
         self._per_peer: dict[str, ChaosConfig] = {}
+        # per-link leaky bucket for bandwidth shaping: (local, remote) ->
+        # loop time at which the link's queue drains
+        self._link_busy: dict[tuple[str, str], float] = {}
         # observability: fault class -> injected count (mirrored into
         # libs/metrics by whoever owns a NodeMetrics)
         self.faults: dict[str, int] = {
             "drop": 0, "delay": 0, "duplicate": 0, "reorder": 0,
-            "corrupt": 0, "partition_drop": 0,
+            "corrupt": 0, "partition_drop": 0, "asym_drop": 0,
+            "shaped": 0, "gray_delay": 0, "clock_skew": 0,
         }
 
     # -- topology faults -------------------------------------------------
@@ -113,12 +154,51 @@ class ChaosNetwork:
         a member of every group)."""
         self._groups = [set(g) for g in groups]
 
+    def partition_oneway(
+        self,
+        src: str | set[str] | list[str] | tuple[str, ...],
+        dst: str | set[str] | list[str] | tuple[str, ...],
+    ) -> None:
+        """Asymmetric partition: src→dst traffic is dropped while dst→src
+        flows — the half-open link state symmetric partitions can't model
+        (A believes B is down; B keeps answering into the void)."""
+        to_set = lambda x: {x} if isinstance(x, str) else set(x)  # noqa: E731
+        self._oneway.append((to_set(src), to_set(dst)))
+
     def heal(self) -> None:
         self._groups = []
+        self._oneway = []
 
     def set_peer_config(self, node_id: str, config: ChaosConfig) -> None:
         """Rate override for any link whose far end is `node_id`."""
         self._per_peer[node_id] = config
+
+    def set_gray(self, node_id: str, delay_ms: float) -> None:
+        """Mark a peer gray: every message to it crawls by a fixed
+        `delay_ms` (inheriting the network's other rates) — slow-but-alive
+        rather than dead."""
+        self._per_peer[node_id] = replace(self.config, gray_delay_ms=delay_ms)
+
+    def clock_for(self, node_id: str, base=None):
+        """A per-validator clock under the clock fault classes: a fixed
+        offset (`clock_skew_ms`) and/or an oscillator rate error
+        (`clock_drift` — the ticker scales timeout durations by it, so a
+        fast validator fires consensus timeouts early). Both are drawn
+        from an RNG keyed on (seed, node_id) — NOT the shared stream —
+        so they are identical across runs regardless of the order clocks
+        are handed out. Returns `base` (or the system clock) untouched
+        when both fault classes are off."""
+        from .clock import SYSTEM, SkewedClock
+
+        skew_ms = self.config.clock_skew_ms
+        drift = self.config.clock_drift
+        if skew_ms <= 0 and drift <= 0:
+            return base or SYSTEM
+        r = random.Random(f"{self.config.seed}:clock:{node_id}")
+        offset_ns = int(r.uniform(-skew_ms, skew_ms) * 1e6) if skew_ms > 0 else 0
+        rate = 1.0 + (r.uniform(-drift, drift) if drift > 0 else 0.0)
+        self.faults["clock_skew"] += 1
+        return SkewedClock(base, offset_ns, rate=rate)
 
     def partitioned(self, a: str, b: str) -> bool:
         if not self._groups:
@@ -129,15 +209,30 @@ class ChaosNetwork:
             return False  # ungrouped nodes see everyone
         return not set(ga) & set(gb)
 
+    def partitioned_oneway(self, src: str, dst: str) -> bool:
+        return any(src in s and dst in d for s, d in self._oneway)
+
     # -- per-message fault plan -----------------------------------------
 
-    def plan(self, local: str, remote: str, channel_id: int) -> "_Faults":
+    def plan(
+        self,
+        local: str,
+        remote: str,
+        channel_id: int,
+        nbytes: int = 0,
+        now: float = 0.0,
+    ) -> "_Faults":
         """Roll the dice for ONE message on the (local→remote, channel)
         link. Called under the event loop, so RNG use is serialized and
-        the draw sequence is deterministic per seed."""
+        the draw sequence is deterministic per seed. `nbytes`/`now` (loop
+        time) feed bandwidth shaping; callers that don't shape may omit
+        them."""
         cfg = self._per_peer.get(remote, self.config).for_channel(channel_id)
         if self.partitioned(local, remote):
             self.faults["partition_drop"] += 1
+            return _Faults(drop=True)
+        if self.partitioned_oneway(local, remote):
+            self.faults["asym_drop"] += 1
             return _Faults(drop=True)
         rng = self.rng
         drop = cfg.drop_rate > 0 and rng.random() < cfg.drop_rate
@@ -145,9 +240,23 @@ class ChaosNetwork:
             self.faults["drop"] += 1
             return _Faults(drop=True)
         delay_s = 0.0
+        if cfg.gray_delay_ms > 0:
+            delay_s += cfg.gray_delay_ms / 1e3
+            self.faults["gray_delay"] += 1
+        if cfg.bandwidth_rate > 0 and nbytes > 0:
+            # leaky bucket: the message transmits after everything already
+            # queued on this link; backlog IS the delay (queue buildup)
+            link = (local, remote)
+            start = max(now, self._link_busy.get(link, 0.0))
+            done = start + nbytes / cfg.bandwidth_rate
+            self._link_busy[link] = done
+            if done > now:
+                delay_s += done - now
+                if start > now:
+                    self.faults["shaped"] += 1
         if cfg.delay_ms > 0:
             # exponential with median delay_ms: tail models queueing
-            delay_s = rng.expovariate(0.6931471805599453 / (cfg.delay_ms / 1e3))
+            delay_s += rng.expovariate(0.6931471805599453 / (cfg.delay_ms / 1e3))
             self.faults["delay"] += 1
         duplicate = cfg.duplicate_rate > 0 and rng.random() < cfg.duplicate_rate
         if duplicate:
@@ -205,7 +314,10 @@ class ChaosConnection(Connection):
 
     async def send_message(self, channel_id: int, data: bytes) -> None:
         remote = self.remote or self.inner.remote_addr
-        plan = self.net.plan(self.local, remote, channel_id)
+        plan = self.net.plan(
+            self.local, remote, channel_id,
+            nbytes=len(data), now=asyncio.get_running_loop().time(),
+        )
         if plan.drop:
             return
         if plan.corrupt_at >= 0:

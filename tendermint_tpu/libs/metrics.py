@@ -105,6 +105,23 @@ def record_resilience(name: str, value: float = 1.0) -> None:
     RESILIENCE[name] = RESILIENCE.get(name, 0.0) + value
 
 
+# -- storage-layer events ----------------------------------------------------
+#
+# WAL objects are created before (and sometimes without) a NodeMetrics, so
+# corruption/repair events land here, module-level, exactly like RESILIENCE;
+# NodeMetrics folds them in at render time.
+
+STORAGE: dict[str, float] = {
+    "wal_corrupt_records": 0.0,  # corrupt/torn records hit during replay
+    "wal_repairs": 0.0,  # WAL files truncated to the last whole record
+    "wal_truncated_bytes": 0.0,  # damaged bytes rotated aside by repair
+}
+
+
+def record_storage(name: str, value: float = 1.0) -> None:
+    STORAGE[name] = STORAGE.get(name, 0.0) + value
+
+
 def _fmt(v: float) -> str:
     return str(int(v)) if float(v).is_integer() else repr(float(v))
 
@@ -189,6 +206,18 @@ class NodeMetrics:
         self.crypto_breaker_probes = r.counter(
             "crypto", "tpu_breaker_probes", "half-open probes routed back to TPU"
         )
+        # storage / WAL crash-consistency (consensus/wal.py, folded from
+        # the module-level STORAGE events at render time)
+        self.wal_corrupt_records = r.counter(
+            "wal", "corrupt_records",
+            "corrupt or torn WAL records hit during replay (truncation point logged)",
+        )
+        self.wal_repairs = r.counter(
+            "wal", "repairs", "WAL files truncated to the last whole record on open"
+        )
+        self.wal_truncated_bytes = r.counter(
+            "wal", "truncated_bytes", "damaged WAL bytes rotated aside by repair"
+        )
         # verify hub (crypto/verify_hub.py — process-wide scheduler,
         # folded in at render time like the resilience events)
         self.verifyhub_dispatches = r.counter(
@@ -256,6 +285,9 @@ class NodeMetrics:
         self.crypto_tpu_fallback_sigs._values[()] = RESILIENCE["tpu_fallback_sigs"]
         self.crypto_breaker_opens._values[()] = RESILIENCE["tpu_breaker_opens"]
         self.crypto_breaker_probes._values[()] = RESILIENCE["tpu_breaker_probes"]
+        self.wal_corrupt_records._values[()] = STORAGE["wal_corrupt_records"]
+        self.wal_repairs._values[()] = STORAGE["wal_repairs"]
+        self.wal_truncated_bytes._values[()] = STORAGE["wal_truncated_bytes"]
         self._fold_verify_hub()
         return self.registry.render()
 

@@ -98,6 +98,15 @@ class NodeConfig:
     # TMTPU_CHAOS_* env vars so any node can run under fault load without
     # code changes.
     chaos: object | None = None
+    # chaos-fs storage fault injection (libs/chaosfs.py): a ChaosFSConfig
+    # (TOML section or libs dataclass) or a shared ChaosFS controller;
+    # when active the WAL rides the fault-injecting FS and the block/
+    # state DBs are wrapped in ChaosDB. None consults TMTPU_CHAOS_FS_*.
+    chaos_fs: object | None = None
+    # injectable consensus time source (libs/clock.py). None = system
+    # clock; when chaos-net carries a clock_skew_ms fault class the node
+    # derives its (deterministically skewed) validator clock from it.
+    clock: object | None = None
     # VerifyHub (crypto/verify_hub.py): the node acquires the process
     # hub on start and releases it on stop; every vote/proposal/commit
     # signature then routes through the micro-batching scheduler
@@ -132,8 +141,14 @@ class Node(Service):
         self.node_id = node_id_from_pubkey(node_key.pub_key())
         self.priv_validator = priv_validator
 
-        self.block_store = BlockStore(block_db or MemDB())
-        self.state_store = StateStore(state_db or MemDB())
+        self.chaos_fs = self._resolve_chaos_fs()
+        block_db = block_db or MemDB()
+        state_db = state_db or MemDB()
+        if self.chaos_fs is not None:
+            block_db = self.chaos_fs.wrap_db(block_db)
+            state_db = self.chaos_fs.wrap_db(state_db)
+        self.block_store = BlockStore(block_db)
+        self.state_store = StateStore(state_db)
         self.evidence_db = evidence_db or MemDB()
         self.index_db = index_db or MemDB()
         self.event_bus = EventBus()
@@ -190,6 +205,10 @@ class Node(Service):
                 duplicate_rate=cfg.duplicate_rate,
                 reorder_rate=cfg.reorder_rate,
                 corrupt_rate=cfg.corrupt_rate,
+                bandwidth_rate=cfg.bandwidth_rate,
+                gray_delay_ms=cfg.gray_delay_ms,
+                clock_skew_ms=cfg.clock_skew_ms,
+                clock_drift=cfg.clock_drift,
             )
         if isinstance(cfg, ChaosNetwork):  # shared controller (test nets)
             self.chaos_net = cfg
@@ -204,6 +223,53 @@ class Node(Service):
             return transports
         self.logger.warning("chaos-net fault injection ENABLED: %s", self.chaos_net.config)
         return [self.chaos_net.wrap(t, self.node_id) for t in transports]
+
+    def _resolve_chaos_fs(self):
+        """Resolve NodeConfig.chaos_fs (TOML section, libs dataclass,
+        shared controller, or TMTPU_CHAOS_FS_* env) into a ChaosFS — or
+        None for the real filesystem."""
+        from .config import ChaosFSConfig as TomlChaosFSConfig
+        from .libs.chaosfs import ChaosFS, ChaosFSConfig
+
+        cfg = self.config.chaos_fs
+        explicit_enable = False
+        if isinstance(cfg, TomlChaosFSConfig):  # the TOML config section
+            if not cfg.enabled:
+                return None  # explicit disable beats inherited env vars
+            explicit_enable = True
+            cfg = ChaosFSConfig(
+                seed=cfg.seed,
+                torn_write_rate=cfg.torn_write_rate,
+                torn_offset=cfg.torn_offset,
+                lost_fsync_rate=cfg.lost_fsync_rate,
+                enospc_rate=cfg.enospc_rate,
+                enospc_at_byte=cfg.enospc_at_byte,
+                bitrot_rate=cfg.bitrot_rate,
+            )
+            if not cfg.enabled():
+                # enabled=true with every rate zero: the operator opted in
+                # but left the rates to the TMTPU_CHAOS_FS_* env vars
+                cfg = ChaosFSConfig.from_env()
+        if isinstance(cfg, ChaosFS):  # shared controller (test harnesses)
+            chaos_fs = cfg
+        elif isinstance(cfg, ChaosFSConfig):
+            chaos_fs = ChaosFS(cfg) if cfg.enabled() else None
+        elif cfg is None:
+            env = ChaosFSConfig.from_env()
+            chaos_fs = ChaosFS(env) if env.enabled() else None
+        else:
+            chaos_fs = None
+        if chaos_fs is not None:
+            self.logger.warning(
+                "chaos-fs storage fault injection ENABLED: %s", chaos_fs.config
+            )
+        elif explicit_enable:
+            self.logger.warning(
+                "chaos_fs enabled in config but NO fault class armed "
+                "(all rates zero and no TMTPU_CHAOS_FS_* env) — running "
+                "on the real filesystem"
+            )
+        return chaos_fs
 
     # -- channels --------------------------------------------------------
 
@@ -325,7 +391,19 @@ class Node(Service):
         )
         import tempfile
 
-        wal = WAL(self.config.wal_dir or tempfile.mkdtemp(prefix="cswal-"))
+        wal = WAL(
+            self.config.wal_dir or tempfile.mkdtemp(prefix="cswal-"),
+            fs=self.chaos_fs,
+            logger=self.logger.getChild("wal"),
+        )
+        from .consensus.replay import report_wal_repair
+
+        report_wal_repair(wal, self.logger.getChild("replay"))
+        clock = self.config.clock
+        if self.chaos_net is not None:
+            # clock-skew fault class: the validator's own wall clock is
+            # deterministically wrong (seeded per node id)
+            clock = self.chaos_net.clock_for(self.node_id, base=clock)
         self.consensus = ConsensusState(
             self.config.consensus,
             self.state,
@@ -336,6 +414,7 @@ class Node(Service):
             wal=wal,
             event_bus=self.event_bus,
             mempool=self.mempool,
+            clock=clock,
         )
         self.cs_reactor = ConsensusReactor(
             self.consensus,

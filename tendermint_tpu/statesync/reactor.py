@@ -471,9 +471,23 @@ class StateSyncReactor(Service):
             prev_height = cur.height - 1
             if prev_height < max(1, self.initial_height):
                 break
-            try:
-                prev = await self.dispatcher.light_block(prev_height)
-            except LightBlockNotFoundError:
+            # a dispatcher round can come back empty under transient load
+            # (request timeouts while the event loop is saturated) even
+            # though every peer has the header — retry the height a few
+            # times before abandoning the rest of the backfill window
+            prev = None
+            for attempt in range(3):
+                try:
+                    prev = await self.dispatcher.light_block(prev_height)
+                    break
+                except LightBlockNotFoundError:
+                    if attempt < 2:
+                        await asyncio.sleep(0.2 * (attempt + 1))
+            if prev is None:
+                self.logger.warning(
+                    "backfill: no peer served light block %d; stopping at %d",
+                    prev_height, cur.height,
+                )
                 break
             if prev.header.hash() != cur.header.last_block_id.hash:
                 self.logger.warning("backfill hash chain broken at %d", prev_height)

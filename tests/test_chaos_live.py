@@ -1,0 +1,111 @@
+"""Live-consensus chaos (the ROADMAP item chaos-net couldn't cover):
+with `libs/clock.py` threaded through the consensus SM, a chaos matrix
+over LIVE consensus — not just block-sync — becomes bit-reproducible.
+
+Mechanism: every validator runs on a frozen `ManualClock` parked behind
+genesis time, skewed per validator by the chaos `clock_skew_ms` fault
+class. The vote-time minimum rule (`max(now, block_time + 1ms)`,
+reference voteTime) then floors every non-nil vote timestamp to
+`block_time + 1ms`, and the weighted-median block-time rule propagates
+it: every vote/block timestamp becomes a pure function of (height,
+genesis_time) — identical across runs no matter how asyncio schedules
+delivery, and robust to validators whose wall clocks disagree."""
+
+import pytest
+
+from tendermint_tpu.consensus import messages as m
+from tendermint_tpu.consensus.harness import LocalNetwork, fast_config
+from tendermint_tpu.libs.chaos import ChaosConfig, ChaosNetwork
+from tendermint_tpu.libs.clock import ManualClock
+from tendermint_tpu.types.keys import SignedMsgType
+
+MS = 1_000_000
+TARGET = 3
+
+
+async def _run_live_chaos(seed: int):
+    """One 4-validator live run under asymmetric partition + clock skew.
+    Returns (header_times, own_precommit_times, fault_counters,
+    per-height hash agreement)."""
+    chaos = ChaosNetwork(ChaosConfig(seed=seed, clock_skew_ms=80.0))
+    genesis_ns = 1_700_000_000_000_000_000  # make_genesis's fixed stamp
+    # every validator: frozen behind genesis, then chaos-skewed (±80ms)
+    net = LocalNetwork(
+        4,
+        config=fast_config(),
+        chaos=chaos,
+        base_clock=ManualClock(genesis_ns - 500 * MS),
+    )
+    assert net.genesis.genesis_time_ns == genesis_ns
+    # node0's votes never reach node1; node1's reach node0 (half-open link)
+    chaos.partition_oneway("node0", "node1")
+
+    precommit_ts: dict[tuple[int, int], int] = {}  # (height, val) -> ts
+    await net.start()
+    try:
+        for i, node in enumerate(net.nodes):
+            orig = node.cs.broadcast_hook
+
+            def hook(msg, _i=i, _orig=orig):
+                if (
+                    isinstance(msg, m.VoteMessage)
+                    and msg.vote.type == SignedMsgType.PRECOMMIT
+                    and not msg.vote.block_id.is_nil()
+                ):
+                    precommit_ts.setdefault(
+                        (msg.vote.height, _i), msg.vote.timestamp_ns
+                    )
+                _orig(msg)
+
+            node.cs.broadcast_hook = hook
+        # liveness: the half-open link must not stall the quorum. node1
+        # misses node0-origin proposals and (with no block-sync reactor in
+        # this direct-hook harness) may wedge at that height — production
+        # nodes backfill via part gossip/block-sync — so the progress
+        # requirement is on the other three.
+        import asyncio
+
+        await asyncio.gather(
+            *(net.nodes[i].cs.wait_for_height(TARGET, 45) for i in (0, 2, 3))
+        )
+        header_times = {}
+        agree = True
+        for h in range(1, TARGET + 1):
+            hashes = {
+                n.block_store.load_block(h).hash()
+                for n in net.nodes
+                if n.block_store.height() >= h
+            }
+            agree &= len(hashes) == 1
+            header_times[h] = net.nodes[0].block_store.load_block(h).header.time_ns
+    finally:
+        await net.stop()
+    return header_times, dict(precommit_ts), dict(chaos.faults), agree
+
+
+class TestLiveConsensusChaos:
+    @pytest.mark.asyncio
+    async def test_asym_partition_and_clock_skew_bit_reproducible(self):
+        """Acceptance: live consensus under an asymmetric partition and
+        per-validator clock skew (a) keeps committing with all nodes
+        agreeing per height, and (b) produces IDENTICAL vote/block
+        timestamps across two runs with the same seed."""
+        t1, v1, f1, agree1 = await _run_live_chaos(seed=424)
+        assert agree1, "nodes diverged per height under chaos"
+        assert f1["asym_drop"] > 0, "asymmetric partition never bit"
+        assert f1["clock_skew"] == 4, "per-validator skewed clocks not handed out"
+        genesis_ns = 1_700_000_000_000_000_000
+        # the closed form the deterministic clock guarantees:
+        # block h is stamped genesis + (h-1)ms, votes on it at +1ms more
+        assert t1 == {h: genesis_ns + (h - 1) * MS for h in t1}
+        for (h, _val), ts in v1.items():
+            assert ts == genesis_ns + h * MS
+
+        t2, v2, f2, agree2 = await _run_live_chaos(seed=424)
+        assert agree2
+        assert t2 == t1, "block timestamps not reproducible under same seed"
+        # every (height, validator) precommit observed in both runs has a
+        # bit-identical timestamp
+        common = v1.keys() & v2.keys()
+        assert common
+        assert {k: v1[k] for k in common} == {k: v2[k] for k in common}
