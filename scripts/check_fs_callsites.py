@@ -1,95 +1,35 @@
 #!/usr/bin/env python3
-"""Lint: storage-layer writes must go through the injectable I/O layer
-(`libs/chaosfs.FS`) — no raw binary `open(..)` writes, `os.write`,
-`os.fsync`, or `os.replace/rename` in the WAL/store/state write path.
+"""Shim — the fs-discipline lint now lives in the tmtlint framework.
 
-The crash-consistency guarantees (torn-write/lost-fsync/ENOSPC recovery,
-tests/test_crash_recovery.py) only hold for I/O the chaos-fs layer can
-see: a new raw `open(path, "ab")` in the WAL or stores silently escapes
-fault injection AND the durable-watermark crash model — the matrix keeps
-passing while the real crash path regresses. This lint (wired into
-tier-1 via tests/test_tools.py, like check_verify_callsites.py) makes
-that a hard failure.
+Equivalent to `python scripts/lint.py --rule fs-discipline`; kept so
+existing tier-1 wiring and docs referencing this script keep working.
+The AST analyzer (tendermint_tpu/tools/lint/rules/chokepoint_rules.py)
+replaces the old regex: binary write modes are read off the actual
+`open()` argument, `self.fs.open(...)` is structurally exempt, and the
+allowlist moved to tendermint_tpu/tools/lint/allowlist.json.
 
-Scanned: tendermint_tpu/consensus/wal.py, tendermint_tpu/store/**,
-tendermint_tpu/state/**. Allowlisted:
-  * tendermint_tpu/libs/chaosfs.py — IS the I/O layer;
-  * tendermint_tpu/store/db.py — sqlite3 owns its file descriptors; DB
-    fault injection happens at the `ChaosDB` wrapper, not under sqlite.
-
-Exit status: 0 clean, 1 violations (printed as file:line: text).
+Exit status: 0 clean, 1 violations.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-SCAN_PREFIXES = (
-    "tendermint_tpu/consensus/wal.py",
-    "tendermint_tpu/store/",
-    "tendermint_tpu/state/",
-)
-
-ALLOWLIST_PREFIXES = (
-    "tendermint_tpu/store/db.py",  # sqlite3-owned descriptors; see ChaosDB
-)
-
-# binary write/append/update opens + the os-level mutation calls the FS
-# layer wraps. Read-only opens ("rb") are allowed: bit-rot injection only
-# matters where the caller can be handed an FS (the WAL takes one).
-PATTERNS = (
-    # bare builtin open() with a binary write/append/update mode — a
-    # leading `.` (self.fs.open, chaosfs-layer calls) is exempt
-    re.compile(r"""(?<![\w.])open\s*\([^)]*,\s*["'][^"']*[wax+][^"']*b[^"']*["']"""),
-    re.compile(r"""(?<![\w.])open\s*\([^)]*,\s*["'][^"']*b[^"']*[wax+][^"']*["']"""),
-    re.compile(r"\bos\s*\.\s*(write|fsync|open|rename|replace|remove|truncate)\s*\("),
-)
-
-
-def find_violations() -> list[tuple[str, int, str]]:
-    out = []
-    for prefix in SCAN_PREFIXES:
-        root = os.path.join(REPO, prefix)
-        paths = [root] if root.endswith(".py") else [
-            os.path.join(dp, fn)
-            for dp, _dn, fns in os.walk(root)
-            for fn in sorted(fns)
-            if fn.endswith(".py")
-        ]
-        for path in paths:
-            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
-            if any(rel.startswith(p) for p in ALLOWLIST_PREFIXES):
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if any(p.search(line) for p in PATTERNS):
-                        out.append((rel, lineno, line.strip()))
-    return out
-
-
-def main() -> int:
-    violations = find_violations()
-    if not violations:
-        print("fs-callsite lint: clean")
-        return 0
-    print(
-        "fs-callsite lint: %d raw storage I/O call site(s) outside the "
-        "injectable chaos-fs layer:" % len(violations),
-        file=sys.stderr,
-    )
-    for rel, lineno, text in violations:
-        print(f"  {rel}:{lineno}: {text}", file=sys.stderr)
-    print(
-        "route these through the injectable libs/chaosfs.FS (self.fs.open/"
-        "fsync/rename/...), or extend the allowlist with a reason.",
-        file=sys.stderr,
-    )
-    return 1
-
+from lint import main  # noqa: E402  (scripts/lint.py)
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # scoped to the rule's scan surface (the old regex lint's SCAN_PREFIXES)
+    sys.exit(
+        main(
+            [
+                "--rule",
+                "fs-discipline",
+                "tendermint_tpu/consensus/wal.py",
+                "tendermint_tpu/store",
+                "tendermint_tpu/state",
+            ]
+        )
+    )

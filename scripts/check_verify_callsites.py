@@ -1,83 +1,26 @@
 #!/usr/bin/env python3
-"""Lint: no direct `*.verify_signature(...)` call sites outside the
-crypto/verify-hub allowlist.
+"""Shim — the verify-chokepoint lint now lives in the tmtlint framework.
 
-Every signature check in the node must route through the VerifyHub
-chokepoints (crypto/verify_hub.verify_one / verify_many or the
-validation _CommitVerifier shim) so it participates in micro-batching
-and gossip-duplicate dedup. A new direct call site silently bypasses
-batching — this lint (wired into tier-1 via tests/test_tools.py) makes
-that a hard failure instead of a perf regression nobody notices.
+Equivalent to `python scripts/lint.py --rule verify-chokepoint`; kept so
+existing tier-1 wiring and docs referencing this script keep working.
+The AST analyzer (tendermint_tpu/tools/lint/rules/chokepoint_rules.py)
+replaces the old regex: it resolves actual `*.verify_signature(...)`
+call expressions, and the allowlist moved to
+tendermint_tpu/tools/lint/allowlist.json.
 
-Allowlisted:
-  * tendermint_tpu/crypto/** — the backends and the hub itself;
-  * tendermint_tpu/p2p/secret.py — the handshake challenge: one
-    latency-critical signature before the peer even exists, verified
-    inline by design;
-  * tendermint_tpu/tools/signer_harness.py — external-signer
-    conformance harness; it deliberately verifies exactly what the
-    remote signer returned, with no caching layer in between.
-
-Exit status: 0 clean, 1 violations (printed as file:line: text).
+Exit status: 0 clean, 1 violations.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PACKAGE = os.path.join(REPO, "tendermint_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-ALLOWLIST_PREFIXES = (
-    "tendermint_tpu/crypto/",
-    "tendermint_tpu/p2p/secret.py",
-    "tendermint_tpu/tools/signer_harness.py",
-)
-
-CALL_RE = re.compile(r"\.\s*verify_signature\s*\(")
-DEF_RE = re.compile(r"def\s+verify_signature\s*\(")
-
-
-def find_violations() -> list[tuple[str, int, str]]:
-    out = []
-    for dirpath, _dirnames, filenames in os.walk(PACKAGE):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, REPO).replace(os.sep, "/")
-            if any(rel.startswith(p) for p in ALLOWLIST_PREFIXES):
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if DEF_RE.search(line):
-                        continue  # interface definitions are fine
-                    if CALL_RE.search(line):
-                        out.append((rel, lineno, line.strip()))
-    return out
-
-
-def main() -> int:
-    violations = find_violations()
-    if not violations:
-        print("verify-callsite lint: clean")
-        return 0
-    print(
-        "verify-callsite lint: %d direct verify_signature call site(s) "
-        "outside the VerifyHub allowlist:" % len(violations),
-        file=sys.stderr,
-    )
-    for rel, lineno, text in violations:
-        print(f"  {rel}:{lineno}: {text}", file=sys.stderr)
-    print(
-        "route these through crypto/verify_hub.verify_one (or the "
-        "validation batch shim), or extend the allowlist with a reason.",
-        file=sys.stderr,
-    )
-    return 1
-
+from lint import main  # noqa: E402  (scripts/lint.py)
 
 if __name__ == "__main__":
-    sys.exit(main())
+    # scoped to the rule's scan surface: the package (matches the old
+    # regex lint; scripts/ and tests/ were never in its remit)
+    sys.exit(main(["--rule", "verify-chokepoint", "tendermint_tpu"]))

@@ -140,6 +140,7 @@ async def _serve_kvstore(spec: str, persist: str | None) -> int:
     print(f"kvstore listening on {scheme}://{host}:{srv.port}", flush=True)
     try:
         await asyncio.Event().wait()
+    # tmtlint: allow[absorbed-cancellation] -- CLI top frame: the interrupt IS the shutdown signal; stop the server and exit 0
     except (KeyboardInterrupt, asyncio.CancelledError):
         pass
     await srv.stop()

@@ -245,6 +245,8 @@ class SocketClient(Client):
                 fut = self._pending.popleft()
                 if not fut.done():
                     fut.set_exception(ConnectionError(f"abci connection lost: {e!r}"))
+            if isinstance(e, asyncio.CancelledError):
+                raise  # propagate after failing the waiters, or stop() wedges
 
     async def _call(self, method: str, req=None):
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

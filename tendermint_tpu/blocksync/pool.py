@@ -18,9 +18,9 @@ acted on anything."""
 from __future__ import annotations
 
 import logging
-import time
 from dataclasses import dataclass, field
 
+from ..libs.clock import SYSTEM, Clock
 from ..types.block import Block
 
 REQUEST_WINDOW = 128  # in-flight heights (reference: 600)
@@ -82,18 +82,28 @@ class _Request:
 
 
 class BlockPool:
-    def __init__(self, start_height: int, *, logger: logging.Logger | None = None):
+    def __init__(
+        self,
+        start_height: int,
+        *,
+        clock: Clock | None = None,
+        logger: logging.Logger | None = None,
+    ):
         self.height = start_height  # next height to hand to the verifier
         self.logger = logger or logging.getLogger("blockpool")
+        # duration domain only (RTO samples, ban cooldowns, grace
+        # windows) — never stamped into protocol output; injectable so
+        # chaos clock drift skews this node's timeout bookkeeping too
+        self._clock = clock or SYSTEM
         self.peers: dict[str, _Peer] = {}
         self.requests: dict[int, _Request] = {}  # height -> outstanding req
         self.blocks: dict[int, tuple[Block, str]] = {}  # height -> (block, provider)
-        self.started_at = time.monotonic()
-        self._last_advance = time.monotonic()
+        self.started_at = self._clock.monotonic()
+        self._last_advance = self._clock.monotonic()
         # when the peer set last BECAME empty — the zero-peer caught-up
         # grace measures from here, not from pool start, so a transient
         # total peer loss mid-sync doesn't instantly report caught-up
-        self._no_peers_since = time.monotonic()
+        self._no_peers_since = self._clock.monotonic()
         self._banned: list[str] = []  # drained by the reactor (take_banned)
         # quarantine expiry per banned peer: a TIMED ban, not a permanent
         # one — transient total-loss events (a partition) must not strand
@@ -103,7 +113,7 @@ class BlockPool:
     # -- peers -----------------------------------------------------------
 
     def set_peer_range(self, peer_id: str, base: int, height: int) -> None:
-        if time.monotonic() < self._ban_until.get(peer_id, 0.0):
+        if self._clock.monotonic() < self._ban_until.get(peer_id, 0.0):
             return
         p = self.peers.setdefault(peer_id, _Peer(peer_id))
         p.base, p.height = base, height
@@ -114,7 +124,7 @@ class BlockPool:
         if p is None:
             return []
         if not self.peers:
-            self._no_peers_since = time.monotonic()
+            self._no_peers_since = self._clock.monotonic()
         redo = []
         for h in list(p.pending):
             self.requests.pop(h, None)
@@ -133,7 +143,7 @@ class BlockPool:
             peer.peer_id[:12],
             peer.timeouts,
         )
-        self._ban_until[peer.peer_id] = time.monotonic() + BAN_COOLDOWN
+        self._ban_until[peer.peer_id] = self._clock.monotonic() + BAN_COOLDOWN
         self._banned.append(peer.peer_id)
         self.remove_peer(peer.peer_id)
 
@@ -146,7 +156,7 @@ class BlockPool:
         """Assign un-requested heights within the window to peers with
         capacity (reference makeNextRequests pool.go:394)."""
         out = []
-        now = time.monotonic()
+        now = self._clock.monotonic()
         # retry timed-out requests first (per-peer adaptive RTO)
         for h, req in list(self.requests.items()):
             if h in self.blocks:
@@ -203,7 +213,7 @@ class BlockPool:
             if assigned is not None:
                 assigned.pending.discard(h)
                 if req.peer_id == peer_id:
-                    assigned.observe_rtt(time.monotonic() - req.time)
+                    assigned.observe_rtt(self._clock.monotonic() - req.time)
             del self.requests[h]
         return True
 
@@ -234,7 +244,7 @@ class BlockPool:
         self.blocks.pop(height, None)
         if height >= self.height:
             self.height = height + 1
-            self._last_advance = time.monotonic()
+            self._last_advance = self._clock.monotonic()
 
     def redo(self, height: int, *bad_peers: str) -> None:
         """Verification failed: drop blocks from the offending providers
@@ -257,4 +267,4 @@ class BlockPool:
         # the moment we LAST had no peers, not pool start), then hand
         # over — consensus lag triggers a switch-back if a taller peer
         # shows up later (reactor.resume)
-        return time.monotonic() - self._no_peers_since > 5.0
+        return self._clock.monotonic() - self._no_peers_since > 5.0

@@ -16,8 +16,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 
+from ..libs.clock import SYSTEM, Clock
 from ..libs.service import Service
 from ..p2p.peermanager import PeerStatus
 from ..p2p.router import Channel
@@ -46,9 +46,13 @@ class BlockSyncReactor(Service):
         *,
         window: int = DEFAULT_WINDOW,
         active: bool = True,
+        clock: Clock | None = None,
         logger: logging.Logger | None = None,
     ):
         super().__init__("bs-reactor", logger)
+        # duration domain (range-verify latency, pool RTO/ban clocks);
+        # injected so chaos clock drift reaches sync bookkeeping too
+        self.clock = clock or SYSTEM
         self.state = state
         self.block_exec = block_exec
         self.block_store = block_store
@@ -59,7 +63,7 @@ class BlockSyncReactor(Service):
         # applies (a validator started without block-sync must not race
         # live consensus for the same heights)
         self.active = active
-        self.pool = BlockPool(state.last_block_height + 1)
+        self.pool = BlockPool(state.last_block_height + 1, clock=self.clock)
         self.synced = asyncio.Event()  # set on caught-up (switch to consensus)
         self.metrics = {
             "blocks_applied": 0,
@@ -219,9 +223,9 @@ class BlockSyncReactor(Service):
             n_sigs = sum(
                 sum(1 for s in e[3].signatures if s.is_commit()) for e in entries
             )
-            t0 = time.monotonic()
+            t0 = self.clock.monotonic()
             await asyncio.to_thread(verify_commit_range, chain_id, entries)
-            dt = time.monotonic() - t0
+            dt = self.clock.monotonic() - t0
             self.metrics["ranges"] += 1
             self.metrics["sigs_verified"] += n_sigs
             # the batch proved the commits FOR first_height..first+len-1

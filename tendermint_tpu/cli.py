@@ -250,6 +250,7 @@ def cmd_replay(args) -> int:
         from .types.genesis import GenesisDoc
 
         p = _paths(_home(args))
+        # tmtlint: allow[blocking-in-async] -- one-shot CLI startup read; nothing else is on the loop yet
         with open(p["genesis"]) as f:
             genesis = GenesisDoc.from_json(f.read())
         block_store = BlockStore(SQLiteDB(os.path.join(p["data"], "blockstore.db")))
@@ -323,6 +324,7 @@ def cmd_debug(args) -> int:
             os.makedirs(args.output_dir, exist_ok=True)
             snap = await collect_node_state(client)
             write_dump_bundle(args.output_dir, snap, home)
+            # tmtlint: allow[blocking-in-async] -- debug-dump CLI: tiny pidfile read, no serving loop to starve
             with open(os.path.join(home, "node.pid")) as f:
                 pid = int(f.read().strip())
             os.kill(pid, _sig.SIGUSR1)  # goroutine-dump analog

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from dataclasses import dataclass
 from typing import Callable
 
@@ -947,9 +946,9 @@ class ConsensusState(Service):
 
     async def wait_for_height(self, height: int, timeout: float = 30.0) -> None:
         """Test helper: block until consensus commits `height`."""
-        deadline = time.monotonic() + timeout
+        deadline = self.clock.monotonic() + timeout
         while self.rs.height <= height:
-            remaining = deadline - time.monotonic()
+            remaining = deadline - self.clock.monotonic()
             if remaining <= 0:
                 raise TimeoutError(
                     f"consensus stuck at height {self.rs.height} (wanted > {height})"

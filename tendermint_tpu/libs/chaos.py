@@ -344,8 +344,10 @@ class ChaosConnection(Connection):
         try:
             for _ in range(copies):
                 await self.inner.send_message(channel_id, data)
-        except (ConnectionError, asyncio.CancelledError):
+        except ConnectionError:
             pass  # link died while the message was in flight
+        except asyncio.CancelledError:
+            raise  # teardown cancels in-flight deliveries; don't absorb it
 
     async def receive_message(self) -> tuple[int, bytes]:
         return await self.inner.receive_message()

@@ -44,6 +44,19 @@ class Clock:
         """Real seconds this clock's owner waits for a nominal duration."""
         return duration_ns / 1e9 / self.rate
 
+    def monotonic_ns(self) -> int:
+        """Duration/deadline domain: RTO samples, ban cooldowns, grace
+        windows. Never stamped into protocol output, and it always
+        advances — a ManualClock freezes only the wall-clock domain, so
+        deadline watchdogs (e.g. `wait_for_height`) still fire under a
+        frozen clock. Scaled by `rate`: a fast oscillator's owner sees
+        durations elapse early, matching its scaled timeouts."""
+        return int(time.monotonic_ns() * self.rate)
+
+    def monotonic(self) -> float:
+        """`monotonic_ns` in float seconds (the time.monotonic shape)."""
+        return self.monotonic_ns() / 1e9
+
 
 class SystemClock(Clock):
     def now_ns(self) -> int:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from dataclasses import dataclass, field
 
 from .abci.application import Application
@@ -358,6 +359,7 @@ class Node(Service):
                 self.pex_ch,
                 self.peer_manager.subscribe(),
                 seed_mode=True,
+                rng=random.Random(self.node_id),
             )
             await self.router.start()
             await self.pex_reactor.start()
@@ -437,6 +439,7 @@ class Node(Service):
             self.blocksync_ch,
             self.peer_manager.subscribe(),
             active=self.config.block_sync,
+            clock=clock,
         )
 
         self.statesync_reactor = StateSyncReactor(
@@ -477,8 +480,10 @@ class Node(Service):
                         self.metrics.blocksync_applied._values[()] = m["blocks_applied"]
                         self.metrics.blocksync_sigs._values[()] = m["sigs_verified"]
                         self.metrics.blocksync_bans._values[()] = m["peer_bans"]
-                except Exception:
-                    pass
+                except Exception as e:
+                    # metrics must never kill the node, but a silent drop
+                    # hides real folding bugs — leave a trace
+                    self.logger.debug("metrics fold failed: %r", e)
 
         self.spawn(_metrics_loop(), name="node.metrics")
 
@@ -490,7 +495,12 @@ class Node(Service):
             await self.indexer.start()
 
         self.pex_reactor = PexReactor(
-            self.peer_manager, self.pex_ch, self.peer_manager.subscribe()
+            self.peer_manager,
+            self.pex_ch,
+            self.peer_manager.subscribe(),
+            # deterministic per node id: same-seed chaos runs replay the
+            # same PEX gossip targets
+            rng=random.Random(self.node_id),
         )
 
         await self.router.start()
@@ -602,8 +612,8 @@ class Node(Service):
         if self.rpc_server is not None:
             try:
                 await self.rpc_server.stop()
-            except Exception:
-                pass
+            except Exception as e:
+                self.logger.warning("error stopping rpc server: %r", e)
         for svc in (
             self.cs_reactor,
             self.consensus,
@@ -618,8 +628,10 @@ class Node(Service):
             if svc is not None:
                 try:
                     await svc.stop()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # best-effort teardown: keep stopping the remaining
+                    # services, but say which one failed
+                    self.logger.warning("error stopping %s: %r", svc.name, e)
         try:
             self.peer_manager.save_addr_book()
             if not self.config.seed_mode:

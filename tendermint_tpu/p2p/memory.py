@@ -46,6 +46,7 @@ class MemoryConnection(Connection):
         for p in pending:
             p.cancel()
         if recv in done:
+            # tmtlint: allow[blocking-in-async] -- recv is in asyncio.wait's done set; result() returns immediately
             kind, payload = recv.result()
             if kind == "close":
                 self._closed.set()

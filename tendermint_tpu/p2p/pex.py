@@ -67,12 +67,17 @@ class PexReactor(Service):
         *,
         seed_mode: bool = False,
         seed_disconnect_after: float = 3.0,
+        rng: random.Random | None = None,
         logger: logging.Logger | None = None,
     ):
         super().__init__("pex", logger)
         self.peer_manager = peer_manager
         self.channel = channel
         self.peer_updates = peer_updates
+        # peer selection draws from an instance RNG, not the process-
+        # global one: the node seeds it from its node id so same-seed
+        # chaos runs replay the same gossip targets
+        self._rng = rng or random.Random()
         self.peers: list[str] = []
         # seed mode (reference node/node.go:490 makeSeedNode): the node
         # exists only to crawl and serve addresses — on connect it pushes
@@ -160,7 +165,7 @@ class PexReactor(Service):
             await asyncio.sleep(REQUEST_INTERVAL)
             if not self.peers:
                 continue
-            peer = random.choice(self.peers)
+            peer = self._rng.choice(self.peers)
             try:
                 self.channel.out_q.put_nowait(
                     Envelope(PEX_CHANNEL, PexRequest(), to=peer)

@@ -124,8 +124,8 @@ class Router(Service):
         for transport in self.transports.values():
             try:
                 await transport.close()
-            except Exception:
-                pass
+            except Exception as e:
+                self.logger.debug("transport close failed: %r", e)
         for peer in list(self._peers.values()):
             await self._teardown_peer_state(peer)
 

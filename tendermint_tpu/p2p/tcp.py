@@ -117,6 +117,7 @@ class TCPConnection(Connection):
             if t == _T_PING:
                 try:
                     await self._send_raw(_T_PONG, 0, b"")
+                # tmtlint: allow[absorbed-cancellation] -- pong is best-effort; a dead link surfaces on the next read
                 except Exception:
                     pass
             # pongs are simply fresh-ness signals; drop

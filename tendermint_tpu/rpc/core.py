@@ -5,6 +5,7 @@ the reference's JSON)."""
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from dataclasses import dataclass, field
 from typing import Any
@@ -125,6 +126,10 @@ class Environment:
     node_info: Any = None
     metrics: Any = None  # NodeMetrics, rendered by /metrics
     logger: logging.Logger = field(default_factory=lambda: logging.getLogger("rpc"))
+    # in-flight fire-and-forget CheckTx tasks (broadcast_tx_async): held
+    # so they are reachable (cancellable, exceptions retrieved) instead
+    # of floating free of every Service reap
+    _checktx_tasks: set = field(default_factory=set, repr=False)
 
     # ------------------------------------------------------------------
     # info routes
@@ -335,16 +340,18 @@ class Environment:
 
     async def broadcast_tx_async(self, tx: str) -> dict:
         raw = bytes.fromhex(tx)
-        import asyncio
-
-        asyncio.get_running_loop().create_task(self._checktx_quiet(raw))
+        t = asyncio.get_running_loop().create_task(self._checktx_quiet(raw))
+        self._checktx_tasks.add(t)
+        t.add_done_callback(self._checktx_tasks.discard)
         return {"code": 0, "hash": _hex(sha256(raw)), "log": ""}
 
     async def _checktx_quiet(self, raw: bytes) -> None:
         try:
             await self.mempool.check_tx(raw)
-        except Exception:
-            pass
+        except Exception as e:
+            # async broadcast promises no CheckTx result; rejections are
+            # expected noise but must not vanish without a trace
+            self.logger.debug("async checktx dropped tx: %r", e)
 
     async def broadcast_tx_sync(self, tx: str) -> dict:
         raw = bytes.fromhex(tx)

@@ -284,5 +284,6 @@ class RPCServer:
         try:
             async for msg in sub:
                 await ws.send_json(_rpc_response(id_, _event_json(msg)))
-        except Exception:
-            pass
+        except Exception as e:
+            # client gone / send raced the close — the pump just ends
+            self.logger.debug("ws event pump %s ended: %r", id_, e)

@@ -1,0 +1,464 @@
+"""tmtlint core — shared-AST analyzer framework.
+
+The node's correctness rests on a handful of *call-site disciplines*
+that no type checker sees: every signature must funnel through the
+VerifyHub chokepoint, every storage write must be visible to chaos-fs,
+every consensus timestamp must come from the injected Clock, and no
+coroutine may swallow `asyncio.CancelledError` (the py3.10 `wait_for`
+absorption class behind the PR 1 shutdown hangs). PRs 1-3 guarded two
+of these with regex greps; this framework replaces them with real AST
+analysis: each file is parsed ONCE into a `FileContext` (tree + parent
+links + pragma table, lazily computed and shared) and every registered
+`Rule` walks that tree, so adding an analyzer costs one class, not one
+more O(files) text scan.
+
+Suppression is explicit and auditable, never silent:
+
+  * per-line pragma::
+
+        do_thing()  # tmtlint: allow[rule-id] -- why this one is fine
+
+    A pragma suppresses findings of the named rule(s) on its own line
+    (or, for a comment-only line, the next code line below). The
+    ``-- reason`` part is MANDATORY — a pragma without a reason does
+    not suppress and is itself reported as a `bad-pragma` finding.
+    ``allow[*]`` suppresses every rule (use sparingly).
+
+  * checked-in allowlist (``allowlist.json`` next to this module):
+    per-rule path prefixes with reasons, for whole-file exemptions
+    (e.g. crypto/ backends ARE the verify chokepoint).
+
+Profiles: files under ``tests/`` get the RELAXED profile — only rules
+that declare ``profiles`` containing ``"tests"`` run there (tests
+legitimately block, sleep, and use wall clocks; they must still not
+swallow cancellation). Everything else gets the strict ``"node"``
+profile.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+
+#: rule id reserved for malformed pragmas (reason missing / unknown syntax)
+BAD_PRAGMA = "bad-pragma"
+
+_PRAGMA_RE = re.compile(
+    r"#\s*tmtlint:\s*allow\[([^\]]*)\]\s*(?:--\s*(\S.*))?"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "snippet": self.snippet,
+        }
+
+
+@dataclass
+class Pragma:
+    line: int  # line the pragma comment sits on (1-based)
+    rules: frozenset[str]  # rule ids, or {"*"}
+    reason: str | None
+    comment_only: bool  # pragma is the whole line -> applies to next code line
+
+
+class FileContext:
+    """One parsed file, shared by every rule.
+
+    Parent links and the async-enclosure test are the two facts nearly
+    every analyzer needs; they are computed once here instead of per
+    rule.
+    """
+
+    def __init__(self, rel: str, source: str, tree: ast.Module):
+        self.rel = rel
+        self.source = source
+        self.tree = tree
+        self.lines = source.splitlines()
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    # -- structure -----------------------------------------------------
+
+    @property
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        """Nearest enclosing def/async def (the function whose *body*
+        executes `node` — a nested sync def inside an async def is its
+        own execution context)."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def in_async_def(self, node: ast.AST) -> bool:
+        return isinstance(self.enclosing_function(node), ast.AsyncFunctionDef)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    _aliases: dict[str, str] | None = None
+
+    @property
+    def import_aliases(self) -> dict[str, str]:
+        """local binding -> dotted origin, from `import x [as y]` and
+        top-level-module `from m import n [as a]` — so `from time import
+        sleep` / `import time as t` cannot evade a `time.sleep` rule
+        pattern by renaming."""
+        if self._aliases is None:
+            table: dict[str, str] = {}
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.asname:
+                            table[a.asname] = a.name
+                        else:
+                            head = a.name.split(".")[0]
+                            table[head] = head
+                elif (
+                    isinstance(node, ast.ImportFrom)
+                    and node.module
+                    and node.level == 0
+                ):
+                    for a in node.names:
+                        table[a.asname or a.name] = f"{node.module}.{a.name}"
+            self._aliases = table
+        return self._aliases
+
+    def resolve_call(self, node: ast.Call) -> str | None:
+        """`call_name` with the first segment resolved through the
+        file's import table: `sleep()` after `from time import sleep`
+        -> "time.sleep"; `t.monotonic()` after `import time as t` ->
+        "time.monotonic"; unimported names pass through unchanged."""
+        name = call_name(node)
+        if name is None:
+            return None
+        head, sep, rest = name.partition(".")
+        origin = self.import_aliases.get(head)
+        if origin is None or origin == head:
+            return name
+        return f"{origin}.{rest}" if rest else origin
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.rel, line, col + 1, message, self.line_text(line))
+
+    # -- pragmas -------------------------------------------------------
+
+    _pragma_table: dict[int, list[Pragma]] | None = None
+    _pragma_raw: list[Pragma] | None = None
+
+    @property
+    def pragmas(self) -> dict[int, list[Pragma]]:
+        """Effective pragmas per *code* line: a comment-only pragma line
+        covers the next non-comment line below it, and stacked pragma
+        comments all attach to (and jointly cover) that line."""
+        if self._pragma_table is None:
+            raw: list[Pragma] = []
+            for line, col, text in self._comments():
+                m = _PRAGMA_RE.search(text)
+                if not m:
+                    continue
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                reason = m.group(2).strip() if m.group(2) else None
+                # comment-only line: nothing but whitespace before the '#'
+                only = not self.lines[line - 1][:col].strip()
+                raw.append(Pragma(line, rules, reason, only))
+            table: dict[int, list[Pragma]] = {}
+            for p in raw:
+                line = p.line
+                if p.comment_only:
+                    # attach to the next non-blank, non-comment line
+                    j = p.line + 1
+                    while j <= len(self.lines) and (
+                        not self.lines[j - 1].strip()
+                        or self.lines[j - 1].lstrip().startswith("#")
+                    ):
+                        j += 1
+                    line = j
+                table.setdefault(line, []).append(p)
+            self._pragma_table = table
+            self._pragma_raw = raw
+        return self._pragma_table
+
+    def _comments(self) -> list[tuple[int, int, str]]:
+        """(line, col, text) of real COMMENT tokens — pragma-shaped text
+        inside string literals/docstrings is neither a pragma nor a
+        bad-pragma (the tree parses, so tokenize essentially always
+        succeeds; on the off chance it doesn't, no comments = no
+        pragmas, never a crash)."""
+        if "tmtlint" not in self.source:
+            return []  # skip the tokenize pass for pragma-free files
+        try:
+            return [
+                (t.start[0], t.start[1], t.string)
+                for t in tokenize.generate_tokens(io.StringIO(self.source).readline)
+                if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError):
+            return []
+
+    def suppressed(self, finding: Finding) -> bool:
+        return any(
+            p.reason is not None and ("*" in p.rules or finding.rule in p.rules)
+            for p in self.pragmas.get(finding.line, ())
+        )
+
+    def pragma_errors(self) -> list[Finding]:
+        self.pragmas  # ensure _pragma_raw is populated
+        out = []
+        for p in self._pragma_raw:
+            if p.reason is None:
+                out.append(
+                    Finding(
+                        BAD_PRAGMA,
+                        self.rel,
+                        p.line,
+                        1,
+                        "pragma is missing its '-- reason'; it does not "
+                        "suppress anything until one is given",
+                        self.line_text(p.line),
+                    )
+                )
+            if not p.rules:
+                out.append(
+                    Finding(
+                        BAD_PRAGMA,
+                        self.rel,
+                        p.line,
+                        1,
+                        "pragma names no rules: use allow[rule-id] or allow[*]",
+                        self.line_text(p.line),
+                    )
+                )
+        return out
+
+
+class Rule:
+    """One analyzer. Subclass, set the class attrs, implement check()."""
+
+    #: stable identifier used in pragmas, --rule filters and JSON output
+    id: str = ""
+    #: one-line statement of the invariant this rule enforces
+    doc: str = ""
+    #: repo-relative path prefixes this rule scans; None = every file
+    scope: tuple[str, ...] | None = None
+    #: profiles the rule participates in; tests/ files run "tests"
+    profiles: tuple[str, ...] = ("node",)
+
+    def applies_to(self, rel: str, profile: str) -> bool:
+        if profile not in self.profiles:
+            return False
+        if self.scope is None:
+            return True
+        return any(rel.startswith(p) for p in self.scope)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:  # override
+        raise NotImplementedError
+
+
+# -- call-name resolution helpers (shared by most rules) ----------------
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Dotted name of a call target when statically resolvable, up to
+    three parts: `open(...)` -> "open", `time.sleep(...)` ->
+    "time.sleep", `x.fs.open(...)` -> "x.fs.open" (no rule pattern
+    matches a 3-part instance chain, so the fs-layer call is exempt —
+    exactly the distinction the old regexes could not make); deeper or
+    computed receivers -> None."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f"{f.value.id}.{f.attr}"
+    if (
+        isinstance(f, ast.Attribute)
+        and isinstance(f.value, ast.Attribute)
+        and isinstance(f.value.value, ast.Name)
+    ):
+        return f"{f.value.value.id}.{f.value.attr}.{f.attr}"
+    return None
+
+
+def method_name(node: ast.Call) -> str | None:
+    """Trailing attribute name for method-style calls: `a.b.verify_signature(...)`
+    -> "verify_signature"; plain-name calls return None."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+# -- allowlist ----------------------------------------------------------
+
+
+@dataclass
+class Allowlist:
+    """Checked-in whole-file exemptions: rule id -> [(prefix, reason)]."""
+
+    entries: dict[str, list[tuple[str, str]]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Allowlist":
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        entries = {
+            rule: [(e["prefix"], e["reason"]) for e in lst]
+            for rule, lst in raw.items()
+        }
+        return cls(entries)
+
+    def exempt(self, rule: str, rel: str) -> bool:
+        return any(
+            rel.startswith(prefix) for prefix, _ in self.entries.get(rule, [])
+        )
+
+
+DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "allowlist.json")
+
+
+# -- runner -------------------------------------------------------------
+
+
+def profile_for(rel: str) -> str:
+    return "tests" if rel.startswith("tests/") else "node"
+
+
+def iter_py_files(paths: list[str], repo: str = REPO) -> Iterator[str]:
+    """Expand files/dirs to repo-relative .py paths, sorted."""
+    out: set[str] = set()
+    for p in paths:
+        absp = p if os.path.isabs(p) else os.path.join(repo, p)
+        if os.path.isfile(absp) and absp.endswith(".py"):
+            out.add(os.path.relpath(absp, repo).replace(os.sep, "/"))
+        elif os.path.isdir(absp):
+            for dirpath, dirnames, filenames in os.walk(absp):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.add(
+                            os.path.relpath(
+                                os.path.join(dirpath, fn), repo
+                            ).replace(os.sep, "/")
+                        )
+    yield from sorted(out)
+
+
+def lint_source(
+    source: str,
+    rel: str,
+    rules: Iterable[Rule],
+    allowlist: Allowlist | None = None,
+    *,
+    report_pragma_errors: bool = True,
+) -> list[Finding]:
+    """Lint one in-memory source blob as if it lived at `rel`.
+
+    This is the seam the fixture tests drive: rules see exactly what
+    they would see on a real file, including profile selection, scope
+    matching, pragma suppression and allowlist exemption.
+    """
+    allowlist = allowlist or Allowlist()
+    profile = profile_for(rel)
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [
+            Finding(
+                "syntax-error",
+                rel,
+                e.lineno or 1,
+                (e.offset or 0) + 1,
+                f"cannot parse: {e.msg}",
+            )
+        ]
+    ctx = FileContext(rel, source, tree)
+    findings: list[Finding] = []
+    for rule in rules:
+        if not rule.applies_to(rel, profile):
+            continue
+        if allowlist.exempt(rule.id, rel):
+            continue
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                findings.append(f)
+    if report_pragma_errors:
+        findings.extend(ctx.pragma_errors())
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(
+    paths: list[str],
+    rules: Iterable[Rule],
+    allowlist: Allowlist | None = None,
+    repo: str = REPO,
+    *,
+    report_pragma_errors: bool = True,
+) -> tuple[list[Finding], int]:
+    """Lint files/dirs; returns (findings, files_scanned)."""
+    rules = list(rules)
+    findings: list[Finding] = []
+    n = 0
+    for rel in iter_py_files(paths, repo):
+        n += 1
+        with open(os.path.join(repo, rel), encoding="utf-8") as f:
+            source = f.read()
+        findings.extend(
+            lint_source(
+                source,
+                rel,
+                rules,
+                allowlist,
+                report_pragma_errors=report_pragma_errors,
+            )
+        )
+    return findings, n

@@ -1,0 +1,19 @@
+"""tmtlint rule registry. Adding an analyzer = write a Rule subclass in
+a module here, include an instance in that module's RULES tuple, and
+list the module below — the driver, pragma machinery, --rule filter and
+JSON output pick it up by its `id` with no further wiring."""
+
+from __future__ import annotations
+
+from . import async_rules, chokepoint_rules, clock_rules, nondeterminism_rules
+
+ALL_RULES = (
+    *async_rules.RULES,
+    *chokepoint_rules.RULES,
+    *clock_rules.RULES,
+    *nondeterminism_rules.RULES,
+)
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+assert len(RULES_BY_ID) == len(ALL_RULES), "duplicate rule id"

@@ -1,0 +1,283 @@
+"""Async-discipline analyzers: the event loop must never be blocked,
+cancellation must never be swallowed, and no task may be fired and
+forgotten.
+
+These three rules guard the failure classes PRs 1-3 paid for in
+debugging time: the statesync backfill flake was event-loop saturation
+(blocking work starving `wait_for` deadlines), the PR 1 shutdown hangs
+were absorbed `CancelledError` (py3.10 `asyncio.wait_for` can eat the
+cancel and convert it to `TimeoutError`), and untracked
+`create_task` results are exactly the tasks `Service.stop`'s bounded
+reap can never reach.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from ..framework import FileContext, Finding, Rule, call_name, method_name
+
+# ---------------------------------------------------------------------------
+
+
+class BlockingInAsync(Rule):
+    id = "blocking-in-async"
+    doc = (
+        "no synchronous blocking call (time.sleep, raw open(), subprocess, "
+        "Future.result(), sqlite3) inside `async def` — use asyncio.sleep / "
+        "asyncio.to_thread / the async APIs"
+    )
+    profiles = ("node",)  # tests drive blocking helpers from async tests freely
+
+    #: statically-resolvable call targets that park the event loop
+    BLOCKING_CALLS = frozenset(
+        {
+            "time.sleep",
+            "open",
+            "input",
+            "os.system",
+            "os.wait",
+            "os.waitpid",
+            "sqlite3.connect",
+            "socket.create_connection",
+            "urllib.request.urlopen",
+        }
+    )
+    BLOCKING_PREFIXES = ("subprocess.",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.in_async_def(node):
+                continue
+            name = ctx.resolve_call(node)
+            if name in self.BLOCKING_CALLS or (
+                name and name.startswith(self.BLOCKING_PREFIXES)
+            ):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"blocking call `{name}(...)` inside `async def "
+                    f"{ctx.enclosing_function(node).name}` parks the event "
+                    "loop (the statesync-backfill saturation class); use the "
+                    "async equivalent or asyncio.to_thread",
+                )
+            # Future.result() with no args blocks a concurrent.futures
+            # future (and raises on a pending asyncio one) — either way
+            # it has no business in a coroutine.
+            elif (
+                method_name(node) == "result"
+                and not node.args
+                and not node.keywords
+            ):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    "`.result()` inside `async def` blocks (or raises) unless "
+                    "the future is already done; await it instead",
+                )
+
+
+# ---------------------------------------------------------------------------
+
+
+def _handler_names(handler: ast.ExceptHandler) -> set[str]:
+    """Exception type names a handler catches; "" means bare except."""
+    t = handler.type
+    if t is None:
+        return {""}
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    names = set()
+    for e in elts:
+        if isinstance(e, ast.Name):
+            names.add(e.id)
+        elif isinstance(e, ast.Attribute):
+            names.add(e.attr)
+    return names
+
+
+def _walk_same_frame(nodes: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements WITHOUT descending into nested def/lambda bodies —
+    code in a nested function executes in a different frame, so its
+    `raise`/`await` say nothing about the enclosing handler/try."""
+    stack: list[ast.AST] = list(nodes)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises what it caught on some path:
+    a bare `raise`, or `raise <bound-name>`. Raising a *different*
+    exception replaces a CancelledError — that does not count, and
+    neither does a `raise` tucked inside a nested function."""
+    for node in _walk_same_frame(handler.body):
+        if isinstance(node, ast.Raise):
+            if node.exc is None:
+                return True
+            if (
+                handler.name
+                and isinstance(node.exc, ast.Name)
+                and node.exc.id == handler.name
+            ):
+                return True
+    return False
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    return all(
+        isinstance(s, ast.Pass)
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+        for s in handler.body
+    )
+
+
+def _try_awaits(try_node: ast.Try) -> bool:
+    return any(isinstance(n, ast.Await) for n in _walk_same_frame(try_node.body))
+
+
+class AbsorbedCancellation(Rule):
+    id = "absorbed-cancellation"
+    doc = (
+        "coroutines must let asyncio.CancelledError propagate: no bare "
+        "except / except BaseException without re-raise, no swallowed "
+        "CancelledError handler, no un-shielded wait_for in cleanup"
+    )
+    # tests too: swallowed cancels in test helpers wedge the suite's
+    # leak-reaping conftest exactly like they wedge Service.stop
+    profiles = ("node", "tests")
+
+    CANCEL_NAMES = {"CancelledError"}
+    BASE_NAMES = {"", "BaseException"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ExceptHandler) and ctx.in_async_def(node):
+                yield from self._check_handler(ctx, node)
+            elif isinstance(node, ast.Call) and ctx.in_async_def(node):
+                yield from self._check_cleanup_wait_for(ctx, node)
+
+    def _check_handler(
+        self, ctx: FileContext, handler: ast.ExceptHandler
+    ) -> Iterator[Finding]:
+        names = _handler_names(handler)
+        if names & self.CANCEL_NAMES and not _reraises(handler):
+            yield ctx.finding(
+                self.id,
+                handler,
+                "`except CancelledError` without re-raise: cleanup is fine, "
+                "but the cancellation must propagate (`raise` at the end) or "
+                "Service.stop wedges on this task",
+            )
+        elif names & self.BASE_NAMES and not _reraises(handler):
+            what = "bare `except:`" if "" in names else "`except BaseException`"
+            yield ctx.finding(
+                self.id,
+                handler,
+                f"{what} in a coroutine catches asyncio.CancelledError and "
+                "does not re-raise it — the py3.10 wait_for absorption class "
+                "behind the PR 1 shutdown hangs; re-raise, or narrow to "
+                "`except Exception`",
+            )
+        elif (
+            "Exception" in names
+            and _body_is_silent(handler)
+            and self._try_of(ctx, handler) is not None
+            and _try_awaits(self._try_of(ctx, handler))
+        ):
+            yield ctx.finding(
+                self.id,
+                handler,
+                "silent `except Exception: pass` around an await discards "
+                "every failure of the awaited call, including "
+                "cancellation-adjacent ones (absorbed-cancel TimeoutError); "
+                "log what was dropped or narrow the except",
+            )
+
+    @staticmethod
+    def _try_of(ctx: FileContext, handler: ast.ExceptHandler) -> ast.Try | None:
+        parent = ctx.parents.get(handler)
+        return parent if isinstance(parent, ast.Try) else None
+
+    def _check_cleanup_wait_for(
+        self, ctx: FileContext, node: ast.Call
+    ) -> Iterator[Finding]:
+        if ctx.resolve_call(node) not in ("asyncio.wait_for", "wait_for"):
+            return
+        # inside a finally: or an except CancelledError: handler the task
+        # is (typically) already being cancelled — pre-3.11 wait_for can
+        # absorb that second cancel; the waited work must be shielded.
+        in_cleanup = False
+        child = node
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(anc, ast.Try) and any(
+                child in ast.walk(s) for s in anc.finalbody
+            ):
+                in_cleanup = True
+                break
+            if (
+                isinstance(anc, ast.ExceptHandler)
+                and _handler_names(anc) & self.CANCEL_NAMES
+            ):
+                in_cleanup = True
+                break
+            child = anc
+        if not in_cleanup or not node.args:
+            return
+        waited = node.args[0]
+        if (
+            isinstance(waited, ast.Call)
+            and ctx.resolve_call(waited) in ("asyncio.shield", "shield")
+        ):
+            return
+        yield ctx.finding(
+            self.id,
+            node,
+            "un-shielded `wait_for` in a cleanup path (finally / "
+            "CancelledError handler): a second cancel can be absorbed "
+            "mid-cleanup (py3.10); wrap the awaited work in asyncio.shield "
+            "or use asyncio.wait",
+        )
+
+
+# ---------------------------------------------------------------------------
+
+
+class TaskLeak(Rule):
+    id = "task-leak"
+    doc = (
+        "create_task/ensure_future results must be tracked (Service.spawn, "
+        "a container, or a done-callback) — a dropped task outlives its "
+        "owner and Service.stop can never reap it"
+    )
+    profiles = ("node",)  # the tests conftest cancels leaked tasks itself
+
+    SPAWNERS = {"create_task", "ensure_future"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Expr):
+                continue
+            call = node.value
+            if not isinstance(call, ast.Call):
+                continue
+            name = method_name(call) or (
+                call.func.id if isinstance(call.func, ast.Name) else None
+            )
+            if name in self.SPAWNERS:
+                yield ctx.finding(
+                    self.id,
+                    call,
+                    f"`{name}(...)` result is dropped: the task is "
+                    "fire-and-forget — unreachable by Service.stop's reap and "
+                    "its exception is never retrieved; use Service.spawn or "
+                    "store it and add a done-callback",
+                )
+
+
+RULES = (BlockingInAsync(), AbsorbedCancellation(), TaskLeak())

@@ -1,0 +1,116 @@
+"""Chokepoint analyzers — AST ports of the PR 2/PR 3 regex lints.
+
+verify-chokepoint: every signature check routes through the VerifyHub
+(`crypto/verify_hub.verify_one` / `verify_many` or the validation
+`_CommitVerifier` shim) so it participates in micro-batching and
+gossip-duplicate dedup. A new direct `*.verify_signature(...)` call
+site silently bypasses batching — the paper's headline metric (commit
+sigs verified/sec) regresses with no test failing.
+
+fs-discipline: storage-layer writes go through the injectable
+`libs/chaosfs.FS`. The crash-consistency guarantees (torn-write /
+lost-fsync / ENOSPC recovery, tests/test_crash_recovery.py) only hold
+for I/O the chaos layer can see; a raw `open(path, "ab")` in the WAL
+escapes both fault injection and the durable-watermark crash model.
+
+The AST versions resolve actual call expressions, so `self.fs.open(...)`
+(the discipline itself) is structurally distinguished from the builtin
+`open(...)` instead of regex-guessed, and `def verify_signature`
+interface definitions never need special-casing.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..framework import FileContext, Finding, Rule, call_name, method_name
+
+
+class VerifyChokepoint(Rule):
+    id = "verify-chokepoint"
+    doc = (
+        "no direct *.verify_signature() outside the crypto/handshake/"
+        "harness allowlist — route through crypto/verify_hub"
+    )
+    scope = ("tendermint_tpu/",)
+    profiles = ("node",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and method_name(node) == "verify_signature"
+            ):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    "direct verify_signature() bypasses VerifyHub "
+                    "micro-batching and verdict dedup (the commit-sigs/sec "
+                    "north star); route through crypto/verify_hub.verify_one "
+                    "or the validation batch shim",
+                )
+
+
+class FsDiscipline(Rule):
+    id = "fs-discipline"
+    doc = (
+        "WAL/store/state write paths must use the injectable "
+        "libs/chaosfs.FS — no raw binary open() writes or os.* mutations"
+    )
+    scope = (
+        "tendermint_tpu/consensus/wal.py",
+        "tendermint_tpu/store/",
+        "tendermint_tpu/state/",
+    )
+    profiles = ("node",)
+
+    OS_MUTATIONS = {
+        "os.write",
+        "os.fsync",
+        "os.open",
+        "os.rename",
+        "os.replace",
+        "os.remove",
+        "os.unlink",
+        "os.truncate",
+        "os.ftruncate",
+    }
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve_call(node)
+            if name in self.OS_MUTATIONS:
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"raw `{name}()` in a storage write path escapes chaos-fs "
+                    "fault injection and the durable-watermark crash model; "
+                    "use the injected libs/chaosfs.FS",
+                )
+            elif name == "open" and self._binary_write_mode(node):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    "raw binary-write `open()` in a storage path: the "
+                    "crash-recovery matrix cannot inject faults it cannot "
+                    "see; use fs.open(...) from the injected chaos-fs layer",
+                )
+
+    @staticmethod
+    def _binary_write_mode(node: ast.Call) -> bool:
+        mode = None
+        if len(node.args) >= 2:
+            mode = node.args[1]
+        for kw in node.keywords:
+            if kw.arg == "mode":
+                mode = kw.value
+        if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+            return False
+        m = mode.value
+        return "b" in m and any(c in m for c in "wax+")
+
+
+RULES = (VerifyChokepoint(), FsDiscipline())

@@ -1,0 +1,63 @@
+"""Clock-discipline analyzer: consensus-adjacent code must read time
+from the injected `libs/clock.Clock`, never from the wall.
+
+PR 3 made live-consensus chaos runs bit-reproducible by threading a
+Clock through consensus state/ticker/reactor; one `time.time_ns()` in a
+scanned path re-introduces wall-clock nondeterminism (vote timestamps,
+RTO/ban bookkeeping that diverges across same-seed runs) and silently
+un-does the clock-skew fault class (a SkewedClock node reading
+`time.monotonic()` is not skewed at all).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..framework import FileContext, Finding, Rule, call_name
+
+
+class ClockDiscipline(Rule):
+    id = "clock-discipline"
+    doc = (
+        "consensus/, blocksync/, statesync/ must use the injected "
+        "libs/clock.Clock (now_ns/monotonic) — not time.* / datetime.now"
+    )
+    scope = (
+        "tendermint_tpu/consensus/",
+        "tendermint_tpu/blocksync/",
+        "tendermint_tpu/statesync/",
+    )
+    profiles = ("node",)
+
+    WALL_CALLS = {
+        "time.time",
+        "time.time_ns",
+        "time.monotonic",
+        "time.monotonic_ns",
+        "time.perf_counter",
+        "time.perf_counter_ns",
+        "datetime.now",
+        "datetime.utcnow",
+        "datetime.datetime.now",
+        "datetime.datetime.utcnow",
+        "date.today",
+    }
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve_call(node)
+            if name in self.WALL_CALLS:
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"direct `{name}()` in a clock-disciplined path: read the "
+                    "injected libs/clock.Clock (now_ns for protocol "
+                    "timestamps, monotonic for durations) so chaos clock "
+                    "skew/drift and same-seed reproducibility keep holding",
+                )
+
+
+RULES = (ClockDiscipline(),)

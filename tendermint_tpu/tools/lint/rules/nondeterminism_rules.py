@@ -1,0 +1,98 @@
+"""Nondeterminism analyzer: seeded/replayable paths must not read
+entropy the seed does not control.
+
+The chaos planes (libs/chaos.py, libs/chaosfs.py) and every protocol
+path they exercise promise bit-reproducibility: same seed, same fault
+schedule, same chain. One `random.choice(...)` against the *module*
+RNG (global state, unseeded) or an `os.urandom` in a gossip decision
+breaks that promise invisibly — the matrix still passes, it just stops
+pinning behavior. Iterating a `set` is the same bug in disguise:
+string hashing is randomized per process (PYTHONHASHSEED), so set
+order differs across runs even with identical contents.
+
+Seeded constructors (`random.Random(seed)`) are the FIX, not a
+violation, and are never flagged. Crypto key/nonce generation wants
+real entropy — that lives in crypto/ (out of scope) or is allowlisted.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..framework import FileContext, Finding, Rule, call_name
+
+
+class Nondeterminism(Rule):
+    id = "nondeterminism"
+    doc = (
+        "seeded chaos/protocol paths must not use the global random "
+        "module, os.urandom, uuid4, or set-iteration order"
+    )
+    scope = (
+        "tendermint_tpu/libs/chaos.py",
+        "tendermint_tpu/libs/chaosfs.py",
+        "tendermint_tpu/consensus/",
+        "tendermint_tpu/blocksync/",
+        "tendermint_tpu/statesync/",
+        "tendermint_tpu/p2p/",
+    )
+    profiles = ("node",)
+
+    #: module-level random.* functions that mutate/read global RNG state
+    GLOBAL_RANDOM = {
+        "random.random",
+        "random.choice",
+        "random.choices",
+        "random.shuffle",
+        "random.sample",
+        "random.randint",
+        "random.randrange",
+        "random.uniform",
+        "random.gauss",
+        "random.getrandbits",
+        "random.randbytes",
+        "random.seed",
+    }
+    ENTROPY = {"os.urandom", "uuid.uuid4", "secrets.token_bytes", "secrets.token_hex"}
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                name = ctx.resolve_call(node)
+                if name in self.GLOBAL_RANDOM:
+                    yield ctx.finding(
+                        self.id,
+                        node,
+                        f"`{name}()` uses the process-global RNG: invisible "
+                        "to the chaos seed, so same-seed runs diverge; use a "
+                        "`random.Random(seed)` instance owned by the "
+                        "component",
+                    )
+                elif name in self.ENTROPY:
+                    yield ctx.finding(
+                        self.id,
+                        node,
+                        f"`{name}()` reads OS entropy in a seeded path — "
+                        "bit-reproducibility dies here; derive from the "
+                        "component's seeded RNG (crypto material belongs in "
+                        "crypto/ or the allowlist)",
+                    )
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                it = node.iter
+                is_set = isinstance(it, ast.Set) or (
+                    isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id in ("set", "frozenset")
+                )
+                if is_set:
+                    yield ctx.finding(
+                        self.id,
+                        it,
+                        "iterating a set: order follows randomized string "
+                        "hashing (PYTHONHASHSEED), so behavior differs across "
+                        "same-seed runs; iterate sorted(...) or a list/dict",
+                    )
+
+
+RULES = (Nondeterminism(),)

@@ -35,6 +35,7 @@ class LoadGenerator:
                     await node.mempool.check_tx(tx)
                     self.sent.append(tx)
                     i += 1
+            # tmtlint: allow[absorbed-cancellation] -- load generator: mempool-full/duplicate rejections are expected noise
             except Exception:
                 pass
             await asyncio.sleep(0.02)

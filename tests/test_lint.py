@@ -1,0 +1,635 @@
+"""tmtlint — the AST invariant analyzer suite (tendermint_tpu/tools/lint).
+
+Every rule gets a positive fixture (the exact pattern it exists to
+catch) and a negative one (the disciplined version must stay clean),
+pragma-suppression semantics are pinned, and the whole-tree run is the
+tier-1 gate: the repo itself must lint clean, fast enough not to eat
+the suite's budget.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from tendermint_tpu.tools.lint import (
+    ALL_RULES,
+    BAD_PRAGMA,
+    DEFAULT_ALLOWLIST,
+    RULES_BY_ID,
+    Allowlist,
+    lint_source,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: a rel path inside every strict-profile scope (consensus is covered by
+#: clock-discipline and nondeterminism; scope-specific tests override)
+NODE_PATH = "tendermint_tpu/consensus/somefile.py"
+
+
+def run(src: str, rule_id: str, rel: str = NODE_PATH, allowlist=None):
+    """Single-rule findings for an inline fixture."""
+    out = lint_source(
+        textwrap.dedent(src), rel, [RULES_BY_ID[rule_id]], allowlist
+    )
+    return [f for f in out if f.rule == rule_id]
+
+
+def run_all(src: str, rel: str = NODE_PATH, allowlist=None):
+    return lint_source(textwrap.dedent(src), rel, ALL_RULES, allowlist)
+
+
+# ---------------------------------------------------------------------------
+# blocking-in-async
+
+
+def test_blocking_sleep_in_async_flagged():
+    src = """
+    import time
+    async def worker():
+        time.sleep(1.0)
+    """
+    fs = run(src, "blocking-in-async")
+    assert len(fs) == 1 and fs[0].line == 4
+
+
+def test_async_sleep_and_sync_sleep_clean():
+    src = """
+    import asyncio, time
+    async def worker():
+        await asyncio.sleep(1.0)
+    def sync_worker():
+        time.sleep(1.0)
+    """
+    assert run(src, "blocking-in-async") == []
+
+
+def test_nested_sync_def_is_its_own_context():
+    # the nested def runs via to_thread — blocking there is the FIX
+    src = """
+    import time, asyncio
+    async def worker():
+        def heavy():
+            time.sleep(1.0)
+        await asyncio.to_thread(heavy)
+    """
+    assert run(src, "blocking-in-async") == []
+
+
+def test_raw_open_and_result_in_async_flagged():
+    src = """
+    async def worker(fut):
+        with open("x") as f:
+            data = f.read()
+        return fut.result()
+    """
+    assert {f.line for f in run(src, "blocking-in-async")} == {3, 5}
+
+
+def test_fs_layer_open_in_async_clean():
+    src = """
+    async def worker(self):
+        with self.fs.open("x", "ab") as f:
+            pass
+    """
+    assert run(src, "blocking-in-async") == []
+
+
+def test_from_import_and_alias_cannot_evade():
+    # `from time import sleep` / `import time as t` resolve through the
+    # file's import table — renaming is not an escape hatch
+    src = """
+    from time import sleep
+    import time as t
+    async def worker():
+        sleep(1.0)
+        t.sleep(1.0)
+    """
+    assert {f.line for f in run(src, "blocking-in-async")} == {5, 6}
+
+
+def test_from_import_cannot_evade_clock_and_random_rules():
+    src = """
+    from time import monotonic
+    from random import choice
+    def deadline():
+        return monotonic() + 5.0
+    def pick(peers):
+        return choice(peers)
+    """
+    assert len(run(src, "clock-discipline", rel="tendermint_tpu/blocksync/x.py")) == 1
+    assert len(run(src, "nondeterminism", rel="tendermint_tpu/p2p/x.py")) == 1
+
+
+def test_subprocess_in_async_flagged():
+    src = """
+    import subprocess
+    async def worker():
+        subprocess.run(["ls"])
+    """
+    assert len(run(src, "blocking-in-async")) == 1
+
+
+def test_blocking_relaxed_for_tests_profile():
+    src = """
+    import time
+    async def helper():
+        time.sleep(0.1)
+    """
+    assert run(src, "blocking-in-async", rel="tests/test_x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# absorbed-cancellation
+
+
+def test_bare_except_without_reraise_flagged():
+    src = """
+    async def loop():
+        try:
+            await work()
+        except:
+            cleanup()
+    """
+    fs = run(src, "absorbed-cancellation")
+    assert len(fs) == 1 and "bare" in fs[0].message
+
+
+def test_base_exception_with_reraise_clean():
+    src = """
+    async def loop():
+        try:
+            await work()
+        except BaseException:
+            cleanup()
+            raise
+    """
+    assert run(src, "absorbed-cancellation") == []
+
+
+def test_swallowed_cancelled_error_flagged_and_reraise_clean():
+    bad = """
+    import asyncio
+    async def loop():
+        try:
+            await work()
+        except asyncio.CancelledError:
+            cleanup()
+    """
+    good = bad + "            raise\n"
+    assert len(run(bad, "absorbed-cancellation")) == 1
+    assert run(good, "absorbed-cancellation") == []
+
+
+def test_cancelled_in_tuple_flagged():
+    src = """
+    import asyncio
+    async def loop():
+        try:
+            await work()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+    """
+    assert len(run(src, "absorbed-cancellation")) == 1
+
+
+def test_silent_except_exception_around_await_flagged():
+    bad = """
+    async def loop(self):
+        try:
+            await work()
+        except Exception:
+            pass
+    """
+    good = """
+    async def loop(self):
+        try:
+            await work()
+        except Exception as e:
+            self.logger.debug("dropped: %r", e)
+    """
+    assert len(run(bad, "absorbed-cancellation")) == 1
+    assert run(good, "absorbed-cancellation") == []
+
+
+def test_unshielded_wait_for_in_cleanup_flagged():
+    bad = """
+    import asyncio
+    async def stop(self):
+        try:
+            await self.run()
+        finally:
+            await asyncio.wait_for(self.drain(), 1.0)
+    """
+    good = """
+    import asyncio
+    async def stop(self):
+        try:
+            await self.run()
+        finally:
+            await asyncio.wait_for(asyncio.shield(self.drain()), 1.0)
+    """
+    fs = run(bad, "absorbed-cancellation")
+    assert len(fs) == 1 and "shield" in fs[0].message
+    assert run(good, "absorbed-cancellation") == []
+
+
+def test_raise_inside_nested_def_is_not_a_reraise():
+    # a `raise` in a nested callback runs in a different frame — the
+    # handler itself still swallows the cancellation
+    src = """
+    async def loop():
+        try:
+            await work()
+        except BaseException:
+            def on_done():
+                raise RuntimeError("nested")
+            register(on_done)
+    """
+    assert len(run(src, "absorbed-cancellation")) == 1
+
+
+def test_sync_function_bare_except_not_this_rules_business():
+    src = """
+    def loop():
+        try:
+            work()
+        except:
+            pass
+    """
+    assert run(src, "absorbed-cancellation") == []
+
+
+def test_absorbed_cancellation_applies_to_tests_profile():
+    src = """
+    import asyncio
+    async def helper():
+        try:
+            await work()
+        except asyncio.CancelledError:
+            pass
+    """
+    assert len(run(src, "absorbed-cancellation", rel="tests/test_x.py")) == 1
+
+
+# ---------------------------------------------------------------------------
+# task-leak
+
+
+def test_dropped_create_task_flagged():
+    src = """
+    import asyncio
+    async def fire(self):
+        asyncio.get_running_loop().create_task(self.work())
+        asyncio.ensure_future(self.work())
+    """
+    assert {f.line for f in run(src, "task-leak")} == {4, 5}
+
+
+def test_tracked_task_clean():
+    src = """
+    import asyncio
+    async def fire(self):
+        t = asyncio.create_task(self.work())
+        self._tasks.append(asyncio.create_task(self.work()))
+        self.spawn(self.work())
+        return t
+    """
+    assert run(src, "task-leak") == []
+
+
+# ---------------------------------------------------------------------------
+# clock-discipline
+
+
+def test_wall_clock_in_consensus_flagged():
+    src = """
+    import time
+    def vote_time():
+        return time.time_ns()
+    def deadline():
+        return time.monotonic() + 5.0
+    """
+    assert {f.line for f in run(src, "clock-discipline")} == {4, 6}
+
+
+def test_injected_clock_clean():
+    src = """
+    def vote_time(self):
+        return self.clock.now_ns()
+    def deadline(self):
+        return self.clock.monotonic() + 5.0
+    """
+    assert run(src, "clock-discipline") == []
+
+
+def test_clock_rule_scoped_to_consensus_adjacent_dirs():
+    src = """
+    import time
+    def stamp():
+        return time.time()
+    """
+    # libs/ (e.g. flowrate meters) and crypto/ are out of scope
+    assert run(src, "clock-discipline", rel="tendermint_tpu/libs/flowrate.py") == []
+    assert len(run(src, "clock-discipline", rel="tendermint_tpu/blocksync/x.py")) == 1
+    assert len(run(src, "clock-discipline", rel="tendermint_tpu/statesync/x.py")) == 1
+
+
+# ---------------------------------------------------------------------------
+# verify-chokepoint
+
+
+def test_direct_verify_signature_flagged():
+    src = """
+    def check(pk, msg, sig):
+        return pk.verify_signature(msg, sig)
+    """
+    fs = run(src, "verify-chokepoint", rel="tendermint_tpu/types/vote.py")
+    assert len(fs) == 1 and "VerifyHub" in fs[0].message
+
+
+def test_verify_signature_interface_def_clean():
+    src = """
+    class PubKey:
+        def verify_signature(self, msg, sig):
+            raise NotImplementedError
+    """
+    assert run(src, "verify-chokepoint", rel="tendermint_tpu/types/keys.py") == []
+
+
+def test_crypto_backends_allowlisted():
+    src = """
+    def check(pk, msg, sig):
+        return pk.verify_signature(msg, sig)
+    """
+    allow = Allowlist.load(DEFAULT_ALLOWLIST)
+    assert (
+        run(src, "verify-chokepoint", rel="tendermint_tpu/crypto/batch.py", allowlist=allow)
+        == []
+    )
+    # ...and the allowlist is per-rule, not a blanket file exemption
+    assert (
+        run(src, "verify-chokepoint", rel="tendermint_tpu/types/vote.py", allowlist=allow)
+        != []
+    )
+
+
+# ---------------------------------------------------------------------------
+# fs-discipline
+
+
+def test_raw_binary_write_open_flagged():
+    src = """
+    def append(path, rec):
+        with open(path, "ab") as f:
+            f.write(rec)
+    """
+    fs = run(src, "fs-discipline", rel="tendermint_tpu/consensus/wal.py")
+    assert len(fs) == 1
+
+
+def test_read_only_and_fs_layer_opens_clean():
+    src = """
+    def read(self, path):
+        with open(path, "rb") as f:
+            return f.read()
+    def append(self, path, rec):
+        with self.fs.open(path, "ab") as f:
+            f.write(rec)
+    """
+    assert run(src, "fs-discipline", rel="tendermint_tpu/consensus/wal.py") == []
+
+
+def test_os_mutations_flagged_in_store_scope_only():
+    src = """
+    import os
+    def swap(a, b):
+        os.replace(a, b)
+        os.fsync(3)
+    """
+    assert {f.line for f in run(src, "fs-discipline", rel="tendermint_tpu/store/x.py")} == {4, 5}
+    # out of scope: p2p has no storage write path to protect
+    assert run(src, "fs-discipline", rel="tendermint_tpu/p2p/x.py") == []
+
+
+def test_sqlite_owned_db_allowlisted():
+    src = """
+    import os
+    def swap(a, b):
+        os.replace(a, b)
+    """
+    allow = Allowlist.load(DEFAULT_ALLOWLIST)
+    assert (
+        run(src, "fs-discipline", rel="tendermint_tpu/store/db.py", allowlist=allow)
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
+# nondeterminism
+
+
+def test_global_random_flagged_seeded_instance_clean():
+    bad = """
+    import random
+    def pick(peers):
+        return random.choice(peers)
+    """
+    good = """
+    import random
+    def make_rng(seed):
+        return random.Random(seed)
+    def pick(rng, peers):
+        return rng.choice(peers)
+    """
+    assert len(run(bad, "nondeterminism", rel="tendermint_tpu/p2p/pex.py")) == 1
+    assert run(good, "nondeterminism", rel="tendermint_tpu/p2p/pex.py") == []
+
+
+def test_os_entropy_flagged():
+    src = """
+    import os
+    def nonce():
+        return os.urandom(8)
+    """
+    assert len(run(src, "nondeterminism", rel="tendermint_tpu/libs/chaos.py")) == 1
+
+
+def test_crypto_handshake_entropy_allowlisted():
+    src = """
+    import os
+    def nonce():
+        return os.urandom(8)
+    """
+    allow = Allowlist.load(DEFAULT_ALLOWLIST)
+    assert (
+        run(src, "nondeterminism", rel="tendermint_tpu/p2p/secret.py", allowlist=allow)
+        == []
+    )
+
+
+def test_set_iteration_flagged_sorted_clean():
+    bad = """
+    def fanout(self, peers):
+        for p in set(peers):
+            self.send(p)
+    """
+    good = """
+    def fanout(self, peers):
+        for p in sorted(set(peers)):
+            self.send(p)
+    """
+    assert len(run(bad, "nondeterminism", rel="tendermint_tpu/p2p/x.py")) == 1
+    assert run(good, "nondeterminism", rel="tendermint_tpu/p2p/x.py") == []
+
+
+# ---------------------------------------------------------------------------
+# pragmas
+
+
+PRAGMA_FIXTURE = """
+import time
+async def worker():
+    time.sleep(1.0){pragma}
+"""
+
+
+def test_pragma_with_reason_suppresses():
+    src = PRAGMA_FIXTURE.format(
+        pragma="  # tmtlint: allow[blocking-in-async] -- fixture: startup only"
+    )
+    assert run_all(src) == []
+
+
+def test_pragma_without_reason_does_not_suppress_and_is_reported():
+    src = PRAGMA_FIXTURE.format(pragma="  # tmtlint: allow[blocking-in-async]")
+    rules = {f.rule for f in run_all(src)}
+    assert rules == {"blocking-in-async", BAD_PRAGMA}
+
+
+def test_pragma_for_other_rule_does_not_suppress():
+    src = PRAGMA_FIXTURE.format(
+        pragma="  # tmtlint: allow[clock-discipline] -- wrong rule"
+    )
+    assert {f.rule for f in run_all(src)} == {"blocking-in-async"}
+
+
+def test_wildcard_pragma_suppresses_everything():
+    src = PRAGMA_FIXTURE.format(pragma="  # tmtlint: allow[*] -- fixture")
+    assert run_all(src) == []
+
+
+def test_comment_line_pragma_covers_next_code_line():
+    src = """
+    import time
+    async def worker():
+        # tmtlint: allow[blocking-in-async] -- fixture: covers the line below
+        time.sleep(1.0)
+    """
+    assert run_all(src) == []
+
+
+def test_stacked_comment_pragmas_all_cover_the_next_code_line():
+    src = """
+    import time, random
+    async def worker():
+        # tmtlint: allow[blocking-in-async] -- fixture: reason one
+        # tmtlint: allow[nondeterminism] -- fixture: reason two
+        time.sleep(random.random())
+    """
+    assert run_all(src, rel="tendermint_tpu/p2p/x.py") == []
+
+
+def test_pragma_inside_string_literal_is_not_a_pragma():
+    # pragma scanning is token-based: pragma-shaped TEXT in a string is
+    # neither a suppression nor a bad-pragma (the line above in this
+    # very file documents the syntax without tripping the tree gate)
+    src = """
+    import time
+    async def worker():
+        doc = "# tmtlint: allow[blocking-in-async] -- not a comment"
+        time.sleep(1.0); bad = "# tmtlint: allow[blocking-in-async]"
+    """
+    assert {f.rule for f in run_all(src)} == {"blocking-in-async"}
+
+
+# ---------------------------------------------------------------------------
+# driver + whole-tree gate (tier-1)
+
+
+def _lint(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"), *args],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+
+
+def test_repo_tree_is_clean_and_fast():
+    """THE gate: the repo's own code holds every invariant the analyzers
+    enforce, and the full run fits the tier-1 time budget (suite is
+    ~815s of 870s — this must stay a rounding error)."""
+    out = _lint("--json")
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["clean"] is True
+    assert payload["files_scanned"] > 100  # actually walked the tree
+    assert len(payload["rules"]) >= 6
+    # bench guard: wall time is recorded in the JSON and bounded
+    assert payload["elapsed_s"] < 10.0, f"lint too slow: {payload['elapsed_s']}s"
+
+
+def test_driver_rule_filter_and_errors():
+    out = _lint("--rule", "no-such-rule")
+    assert out.returncode == 2 and "unknown rule" in out.stderr
+    out = _lint("--list-rules")
+    assert out.returncode == 0
+    for rule in ALL_RULES:
+        assert rule.id in out.stdout
+
+
+def test_driver_rejects_nonexistent_paths():
+    # a typo'd path must NOT scan 0 files and report clean
+    out = _lint("no/such/dir")
+    assert out.returncode == 2 and "no such path" in out.stderr
+
+
+def test_single_rule_run_reports_only_that_rule(tmp_path):
+    # bad pragmas elsewhere in a file must not fail a --rule spot check
+    # (they belong to the full gate); the shims rely on this
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # tmtlint: allow[task-leak]\n"
+    )
+    out = _lint("--rule", "task-leak", "--json", str(bad))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert json.loads(out.stdout)["findings"] == []
+    # the full run still reports both the finding and the bad pragma
+    out = _lint("--json", str(bad))
+    rules = {f["rule"] for f in json.loads(out.stdout)["findings"]}
+    assert rules == {"blocking-in-async", BAD_PRAGMA}
+
+
+def test_driver_reports_findings_with_location(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import asyncio\n"
+        "async def f(self):\n"
+        "    asyncio.ensure_future(self.g())\n"
+    )
+    out = _lint(str(bad))
+    assert out.returncode == 1
+    assert "task-leak" in out.stderr and "bad.py:3" in out.stderr
+    out = _lint("--json", str(bad))
+    assert out.returncode == 1
+    payload = json.loads(out.stdout)
+    assert [f["rule"] for f in payload["findings"]] == ["task-leak"]
+    assert payload["findings"][0]["line"] == 3
