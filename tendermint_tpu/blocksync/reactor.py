@@ -224,7 +224,9 @@ class BlockSyncReactor(Service):
                 sum(1 for s in e[3].signatures if s.is_commit()) for e in entries
             )
             t0 = self.clock.monotonic()
-            await asyncio.to_thread(verify_commit_range, chain_id, entries)
+            await asyncio.to_thread(
+                verify_commit_range, chain_id, entries, lane="backfill"
+            )
             dt = self.clock.monotonic() - t0
             self.metrics["ranges"] += 1
             self.metrics["sigs_verified"] += n_sigs
@@ -273,6 +275,7 @@ class BlockSyncReactor(Service):
                         block_id,
                         height,
                         next_block.last_commit,
+                        lane="backfill",
                     )
                 except InvalidCommitError as e:
                     await self._punish(height, provider, next_provider, e)
@@ -308,6 +311,7 @@ class BlockSyncReactor(Service):
                     block_id,
                     height,
                     next_block.last_commit,
+                    lane="backfill",
                 )
             except InvalidCommitError as e:
                 await self._punish(height, provider, next_provider, e)

@@ -26,6 +26,15 @@ class ConsensusConfig:
     create_empty_blocks_interval_ns: int = 0
     double_sign_check_height: int = 0
     wal_path: str = "data/cs.wal"
+    # two-stage pipelined ingest (consensus/ingest.py): stage 1 verifies
+    # incoming vote/proposal signatures CONCURRENTLY through the async
+    # VerifyHub API (filling device-sized micro-batches from one node),
+    # stage 2 applies in strict arrival order via a reorder buffer.
+    # ingest_max_inflight bounds the in-flight verifications per node
+    # (backpressure into the reactor beyond it). Env mirrors:
+    # TMTPU_INGEST_PIPELINE=0 disables, TMTPU_INGEST_INFLIGHT overrides.
+    ingest_pipeline: bool = True
+    ingest_max_inflight: int = 64
 
     def propose_timeout_ns(self, round_: int) -> int:
         return self.timeout_propose_ns + self.timeout_propose_delta_ns * round_

@@ -164,9 +164,12 @@ class LocalNetwork:
         config: ConsensusConfig | None = None,
         chaos=None,
         base_clock=None,
+        catchup: bool = True,
     ):
         self.genesis, self.keys = make_genesis(n_vals)
         self.chaos = chaos
+        self.catchup = catchup
+        self.catchup_rescues = 0
         clocks = [base_clock] * n_vals
         if chaos is not None:
             clocks = [
@@ -184,6 +187,12 @@ class LocalNetwork:
             await node.start()
         for i, node in enumerate(self.nodes):
             node.cs.broadcast_hook = self._make_hook(i)
+        if self.catchup:
+            self._tasks.append(
+                asyncio.get_running_loop().create_task(
+                    self._catchup_relay(), name="harness.catchup"
+                )
+            )
 
     def _make_hook(self, sender: int):
         def hook(msg):
@@ -222,6 +231,79 @@ class LocalNetwork:
         if isinstance(msg, m.VoteMessage):
             return "add_vote", (msg.vote,)
         return None  # HasVote / NewValidBlock are gossip hints; no-op here
+
+    async def _catchup_relay(self) -> None:
+        """Minimal stand-in for the consensus reactor's catch-up gossip /
+        block-sync rescue (ROADMAP gap): a receiver that missed a
+        decided height's proposal — e.g. the victim of a one-way
+        partition whose only proposer view was the cut link — gets the
+        stored commit's precommits and the block parts replayed from any
+        node that already committed that height. Production nodes get
+        this from `_send_catchup_commit_vote` + part gossip over real
+        routers; without it the direct-hook harness can wedge forever.
+        The relay deliberately ignores the chaos fault plan: it models
+        the out-of-band block-sync path, not the vote-gossip links the
+        chaos layer is partitioning."""
+        from ..types.keys import SignedMsgType
+        from ..types.vote import Vote
+
+        while True:
+            await asyncio.sleep(0.25)
+            for node in self.nodes:
+                cs = node.cs
+                if cs is None or not cs.is_running:
+                    continue
+                h = cs.rs.height
+                donor = next(
+                    (
+                        d
+                        for d in self.nodes
+                        if d is not node
+                        and d.cs is not None
+                        and d.block_store.height() >= h
+                    ),
+                    None,
+                )
+                if donor is None:
+                    continue  # nobody has committed this height yet
+                # canonical commit (from block h+1) when the chain moved
+                # on, else the donor's own seen commit for its tip
+                commit = donor.block_store.load_block_commit(
+                    h
+                ) or donor.block_store.load_seen_commit(h)
+                meta = donor.block_store.load_block_meta(h)
+                if commit is None or meta is None:
+                    continue
+                self.catchup_rescues += 1
+                # open the commit round (the real VoteSetMaj23 exchange
+                # does this) so precommits beyond round+1 are admitted
+                if cs.rs.height == h and cs.rs.votes is not None:
+                    cs.rs.votes.set_peer_maj23(
+                        commit.round, SignedMsgType.PRECOMMIT, "catchup-relay"
+                    )
+                # precommits first: +2/3 moves the receiver to COMMIT and
+                # arms a PartSet for the decided block id …
+                for idx, cs_sig in enumerate(commit.signatures):
+                    if cs_sig.is_absent():
+                        continue
+                    vote = Vote(
+                        type=SignedMsgType.PRECOMMIT,
+                        height=commit.height,
+                        round=commit.round,
+                        block_id=cs_sig.block_id(commit.block_id),
+                        timestamp_ns=cs_sig.timestamp_ns,
+                        validator_address=cs_sig.validator_address,
+                        validator_index=idx,
+                        signature=cs_sig.signature,
+                    )
+                    await cs.add_vote(vote, "catchup-relay")
+                # … then the parts complete the block and finalize fires
+                for idx in range(meta.block_id.part_set_header.total):
+                    part = donor.block_store.load_block_part(h, idx)
+                    if part is not None:
+                        await cs.add_block_part(
+                            h, commit.round, part, "catchup-relay"
+                        )
 
     async def stop(self) -> None:
         for t in self._tasks:

@@ -65,6 +65,7 @@ class ConsensusReactor(Service):
     async def on_start(self) -> None:
         self.cs.step_hook = self._on_new_step
         self.cs.broadcast_hook = self._on_broadcast
+        self.cs.invalid_sig_hook = self._on_invalid_sig
         self.spawn(self._process_peer_updates(), name="csr.peers")
         self.spawn(self._process_state_ch(), name="csr.state")
         self.spawn(self._process_data_ch(), name="csr.data")
@@ -74,6 +75,7 @@ class ConsensusReactor(Service):
     async def on_stop(self) -> None:
         self.cs.step_hook = None
         self.cs.broadcast_hook = None
+        self.cs.invalid_sig_hook = None
         for tasks in self._peer_tasks.values():
             for t in tasks:
                 t.cancel()
@@ -109,6 +111,22 @@ class ConsensusReactor(Service):
             ch.out_q.put_nowait(env)
         except asyncio.QueueFull:
             self.logger.warning("dropping outbound on %s: full", ch.name)
+
+    def _on_invalid_sig(self, peer_id: str, vote) -> None:
+        """The ingest pipeline disproved a peer-supplied vote signature.
+        Before pipelining this was swallowed inside the apply-time
+        VoteSetError; now the peer gets reported to the peer manager
+        (score/ban) like any other protocol violation."""
+        self.spawn(
+            self.vote_ch.error(
+                PeerError(
+                    peer_id,
+                    f"invalid vote signature (h={vote.height} r={vote.round} "
+                    f"val={vote.validator_index})",
+                )
+            ),
+            name="csr.badsig",
+        )
 
     # -- peer lifecycle --------------------------------------------------
 

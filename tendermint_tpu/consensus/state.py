@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from dataclasses import dataclass
 from typing import Callable
 
@@ -49,6 +50,7 @@ from ..types.vote import Proposal, Vote
 from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
 from ..libs import fail
 from . import messages as m
+from .ingest import IngestPipeline
 from .ticker import TimeoutInfo, TimeoutTicker
 from .types import HeightVoteSet, RoundState, RoundStep
 from .wal import WAL, KIND_END_HEIGHT, KIND_MESSAGE
@@ -58,6 +60,10 @@ from .wal import WAL, KIND_END_HEIGHT, KIND_MESSAGE
 class MsgInfo:
     msg: object
     peer_id: str = ""  # "" = internally generated
+    # pipelined-ingest verdict (consensus/ingest.py): True = signature
+    # proven in stage 1, don't re-check at apply; False = proven bad,
+    # drop at apply; None = unknown, apply verifies synchronously
+    sig_ok: bool | None = None
 
 
 # queue sentinel: mempool signalled txs-available (create_empty_blocks=false)
@@ -116,6 +122,29 @@ class ConsensusState(Service):
         self.broadcast_hook: Callable[[object], None] | None = None
         # step-change hook (reactor broadcasts NewRoundStep from it)
         self.step_hook: Callable[[RoundState], None] | None = None
+        # called (peer_id, vote) when the pipeline proved a peer-supplied
+        # signature bad — the reactor turns it into a PeerError
+        self.invalid_sig_hook: Callable[[str, Vote], None] | None = None
+
+        # two-stage pipelined ingest (consensus/ingest.py): stage 1
+        # verifies signatures concurrently through the async hub API,
+        # stage 2 applies in strict arrival order. Env wins over config
+        # (same contract as the TMTPU_VERIFYHUB_* knobs).
+        pipe_on = config.ingest_pipeline
+        env = os.environ.get("TMTPU_INGEST_PIPELINE")
+        if env:
+            pipe_on = env.lower() not in ("0", "false", "no")
+        inflight = config.ingest_max_inflight
+        env = os.environ.get("TMTPU_INGEST_INFLIGHT")
+        if env:
+            inflight = int(env)
+        self.ingest: IngestPipeline | None = None
+        if pipe_on:
+            self.ingest = IngestPipeline(
+                self,
+                max_inflight=inflight,
+                logger=self.logger.getChild("ingest"),
+            )
 
         self._replay_mode = False
         self._paused = False  # switch-back-to-blocksync gate
@@ -133,6 +162,8 @@ class ConsensusState(Service):
     async def on_start(self) -> None:
         if self.wal is not None:
             self.catchup_replay()
+        if self.ingest is not None:
+            self.ingest.start()
         self.spawn(self._receive_routine(), name="cs.receive")
         if not self.config.create_empty_blocks and self.mempool is not None:
             # reference receiveRoutine's txsAvailable case (state.go:770):
@@ -148,6 +179,8 @@ class ConsensusState(Service):
 
     async def on_stop(self) -> None:
         self.ticker.stop()
+        if self.ingest is not None:
+            self.ingest.stop()
         if self.wal is not None:
             self.wal.close()
 
@@ -156,17 +189,27 @@ class ConsensusState(Service):
     # ------------------------------------------------------------------
 
     async def add_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
-        await self.msg_queue.put(MsgInfo(m.ProposalMessage(proposal), peer_id))
+        await self._ingest_put(MsgInfo(m.ProposalMessage(proposal), peer_id))
 
     async def add_block_part(
         self, height: int, round_: int, part: Part, peer_id: str = ""
     ) -> None:
-        await self.msg_queue.put(
+        await self._ingest_put(
             MsgInfo(m.BlockPartMessage(height, round_, part), peer_id)
         )
 
     async def add_vote(self, vote: Vote, peer_id: str = "") -> None:
-        await self.msg_queue.put(MsgInfo(m.VoteMessage(vote), peer_id))
+        await self._ingest_put(MsgInfo(m.VoteMessage(vote), peer_id))
+
+    async def _ingest_put(self, mi: MsgInfo) -> None:
+        """Peer inputs enter through the pipelined ingest when it is
+        running (stage-1 concurrent verify, in-order release); otherwise
+        — pipeline disabled, or the SM not yet started — straight onto
+        the input queue, the sequential facade."""
+        if self.ingest is not None and self.ingest.started and not self._stopping:
+            await self.ingest.submit(mi)
+        else:
+            await self.msg_queue.put(mi)
 
     def get_round_state(self) -> RoundState:
         return self.rs
@@ -385,11 +428,11 @@ class ConsensusState(Service):
     def _handle_msg(self, mi: MsgInfo) -> None:
         msg = mi.msg
         if isinstance(msg, m.ProposalMessage):
-            self._set_proposal(msg.proposal)
+            self._set_proposal(msg.proposal, sig_ok=mi.sig_ok)
         elif isinstance(msg, m.BlockPartMessage):
             self._add_proposal_block_part(msg, mi.peer_id)
         elif isinstance(msg, m.VoteMessage):
-            self._try_add_vote(msg.vote, mi.peer_id)
+            self._try_add_vote(msg.vote, mi.peer_id, sig_ok=mi.sig_ok)
         else:
             self.logger.debug("ignoring message %s", type(msg).__name__)
 
@@ -638,7 +681,7 @@ class ConsensusState(Service):
     # proposal intake
     # ------------------------------------------------------------------
 
-    def _set_proposal(self, proposal: Proposal) -> None:
+    def _set_proposal(self, proposal: Proposal, sig_ok: bool | None = None) -> None:
         """Reference defaultSetProposal state.go:1821."""
         rs = self.rs
         if rs.proposal is not None:
@@ -648,15 +691,22 @@ class ConsensusState(Service):
         proposal.validate_basic()
         if not (-1 <= proposal.pol_round < proposal.round):
             raise ValueError("invalid proposal POL round")
-        # verify proposer signature (state.go:1847) — via the VerifyHub:
-        # the same proposal gossiped by several peers is answered from
-        # the hub's verdict cache instead of re-verified per peer
-        from ..crypto.verify_hub import verify_one
-
-        proposer = rs.validators.get_proposer()
-        sb = proposal.sign_bytes(self.state.chain_id)
-        if not verify_one(proposer.pub_key, sb, proposal.signature):
+        # verify proposer signature (state.go:1847). The pipelined
+        # ingest usually proved (or disproved) it in stage 1 — sig_ok
+        # is only trusted because the (height, round) equality above
+        # pins the same proposer the pipeline verified against. The
+        # sync fallback routes through the VerifyHub: the same proposal
+        # gossiped by several peers is answered from the verdict cache
+        # instead of re-verified per peer.
+        if sig_ok is False:
             raise ValueError("invalid proposal signature")
+        if sig_ok is not True:
+            from ..crypto.verify_hub import verify_one
+
+            proposer = rs.validators.get_proposer()
+            sb = proposal.sign_bytes(self.state.chain_id)
+            if not verify_one(proposer.pub_key, sb, proposal.signature):
+                raise ValueError("invalid proposal signature")
         rs.proposal = proposal
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
@@ -677,8 +727,17 @@ class ConsensusState(Service):
         if rs.proposal_block_parts.is_complete():
             data = rs.proposal_block_parts.assemble()
             block = Block.decode(data)
+            # integrity: the completed block must hash to the proposal's
+            # block id — but only when this part set IS the proposal's.
+            # After enterCommit re-arms the part set for a DECIDED block
+            # (catch-up: +2/3 precommits for a round whose proposal we
+            # missed), rs.proposal may still hold a later round's
+            # proposal for a different block; comparing against it wedged
+            # the height forever (the part set completes exactly once).
             if (
                 rs.proposal is not None
+                and rs.proposal.block_id.part_set_header
+                == rs.proposal_block_parts.header
                 and block.hash() != rs.proposal.block_id.hash
             ):
                 raise ValueError("completed proposal block hash mismatch")
@@ -963,10 +1022,12 @@ class ConsensusState(Service):
     # votes
     # ------------------------------------------------------------------
 
-    def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _try_add_vote(
+        self, vote: Vote, peer_id: str, sig_ok: bool | None = None
+    ) -> bool:
         """Reference tryAddVote state.go:1961."""
         try:
-            return self._add_vote(vote, peer_id)
+            return self._add_vote(vote, peer_id, sig_ok=sig_ok)
         except ConflictingVoteError as e:
             if (
                 self.priv_validator is not None
@@ -979,10 +1040,24 @@ class ConsensusState(Service):
                 return False
             raise
 
-    def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+    def _add_vote(
+        self, vote: Vote, peer_id: str, sig_ok: bool | None = None
+    ) -> bool:
         """Reference addVote state.go:2009 — tallies the vote and drives
         the polka/lock/commit transitions."""
         rs = self.rs
+
+        if sig_ok is False:
+            # the ingest pipeline disproved the signature; surface the
+            # peer to the reactor (ban/score) and drop like any other
+            # invalid input
+            if self.invalid_sig_hook is not None and peer_id:
+                self.invalid_sig_hook(peer_id, vote)
+            raise VoteSetError(
+                f"invalid signature from validator {vote.validator_index} "
+                f"(disproven by pipelined ingest)"
+            )
+        verified = sig_ok is True
 
         # A precommit for the previous height (LastCommit straggler)
         if (
@@ -991,7 +1066,7 @@ class ConsensusState(Service):
         ):
             if rs.step != RoundStep.NEW_HEIGHT or rs.last_commit is None:
                 return False
-            added = rs.last_commit.add_vote(vote)
+            added = rs.last_commit.add_vote(vote, verified=verified)
             if added:
                 self._publish_vote(vote)
                 if self.config.skip_timeout_commit and rs.last_commit.has_all():
@@ -1001,7 +1076,7 @@ class ConsensusState(Service):
         if vote.height != rs.height:
             return False
 
-        added = rs.votes.add_vote(vote, peer_id)
+        added = rs.votes.add_vote(vote, peer_id, verified=verified)
         if not added:
             return False
         self._publish_vote(vote)

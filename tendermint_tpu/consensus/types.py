@@ -117,10 +117,13 @@ class HeightVoteSet:
             self._add_round(r)
         self.round = round_
 
-    def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
+    def add_vote(
+        self, vote: Vote, peer_id: str = "", *, verified: bool = False
+    ) -> bool:
         """Returns True if added. Unwanted catch-up rounds (beyond
         round+1 with no peer maj23 claim) return False rather than
-        raising (reference height_vote_set.go:126)."""
+        raising (reference height_vote_set.go:126). `verified` marks a
+        vote whose signature the ingest pipeline already proved."""
         if vote.height != self.height:
             return False
         vs = self._get_vote_set(vote.round, vote.type)
@@ -131,7 +134,18 @@ class HeightVoteSet:
                 vs = self._get_vote_set(vote.round, vote.type)
             else:
                 return False  # unwanted round; possible DoS, drop
-        return vs.add_vote(vote)
+        return vs.add_vote(vote, verified=verified)
+
+    def wanted(self, vote: Vote, peer_id: str = "") -> bool:
+        """Would add_vote even look at this vote — open round, or a
+        catch-up round this peer claimed a +2/3 majority for? The
+        pipelined ingest checks this BEFORE spending a signature
+        verification, mirroring the unwanted-round DoS drop below: a
+        flood of far-future-round votes must not burn live-lane hub
+        capacity the sequential path never spent."""
+        if self._get_vote_set(vote.round, vote.type) is not None:
+            return True
+        return vote.round in self._peer_catchup_rounds.get(peer_id, [])
 
     def _get_vote_set(self, round_: int, type_: SignedMsgType) -> VoteSet | None:
         rvs = self._round_vote_sets.get(round_)

@@ -13,10 +13,17 @@ unchanged.
 
 Scheduling model (one dispatcher thread + one device-runner thread):
 
-  * requests land in a FIFO of pending entries; identical in-flight
-    triples COALESCE onto one entry (gossip hands every vote to a node
-    several times — the duplicate attaches its future to the pending
-    verify instead of re-entering the queue);
+  * requests land in one of two FIFO lanes — ``live`` (consensus votes
+    and proposals on the hot path) and ``backfill`` (block-sync /
+    state-sync / light-client catch-up traffic). Each dispatch packs
+    the live lane FIRST and only then fills the remaining batch
+    capacity from backfill, so a node replaying history can saturate
+    the device without ever starving the vote it needs to commit the
+    next block. Identical in-flight triples COALESCE onto one entry
+    (gossip hands every vote to a node several times — the duplicate
+    attaches its future to the pending verify instead of re-entering
+    the queue); a live submission coalescing onto a queued backfill
+    entry PROMOTES it into the live lane;
   * a bounded LRU of already-verified ``(key_type, pubkey, sha256(msg),
     sig)`` verdicts answers repeats without any dispatch at all;
   * dispatch fires when a device-sized batch fills, when the adaptive
@@ -26,10 +33,12 @@ Scheduling model (one dispatcher thread + one device-runner thread):
   * the window ADAPTS to measured occupancy: an EWMA of signatures per
     dispatch shrinks the window toward zero under light load and
     stretches it back to the configured ceiling as concurrency appears;
-  * dispatch is double-buffered: the dispatcher hands a packed batch to
-    the runner thread and immediately starts packing the next one, so
-    host-side packing of batch N+1 overlaps device execution of batch N
-    (at most two batches in flight — further packing backpressures).
+  * dispatch is double-buffered: at most two batches are in flight
+    (one executing, one queued at the runner — more adds queueing, not
+    overlap). The dispatcher waits for a free slot BEFORE packing and
+    packs at the last possible moment, so an urgent/live arrival during
+    a full double buffer still makes the very next dispatch instead of
+    sitting behind a pre-packed backfill batch.
 
 The hub is process-wide (like the TPU backend it feeds): `acquire_hub` /
 `release_hub` refcount node lifecycles, and in-process multi-node tests
@@ -58,6 +67,12 @@ from .hashes import sha256
 
 logger = logging.getLogger("crypto.verify_hub")
 
+#: scheduler lanes: live consensus is packed ahead of catch-up backfill
+#: in every micro-batch (see module docstring)
+LANE_LIVE = "live"
+LANE_BACKFILL = "backfill"
+LANES = (LANE_LIVE, LANE_BACKFILL)
+
 #: queue-latency buckets (seconds) — sub-millisecond resolution, because
 #: the whole point of the micro-batch window is single-digit-ms latency
 LATENCY_BUCKETS = (
@@ -69,15 +84,16 @@ class _Pending:
     """One unique (pubkey, msg, sig) triple awaiting a verdict. Duplicate
     submissions while it is queued/in flight append their futures here."""
 
-    __slots__ = ("key", "pub_key", "msg", "sig", "futures", "enqueued_at")
+    __slots__ = ("key", "pub_key", "msg", "sig", "futures", "enqueued_at", "lane")
 
-    def __init__(self, key, pub_key, msg, sig, fut, now):
+    def __init__(self, key, pub_key, msg, sig, fut, now, lane):
         self.key = key
         self.pub_key = pub_key
         self.msg = msg
         self.sig = sig
         self.futures: list[Future] = [fut]
         self.enqueued_at = now
+        self.lane = lane
 
 
 def _cache_key(pub_key: PubKey, msg: bytes, sig: bytes) -> tuple:
@@ -130,7 +146,10 @@ class VerifyHub:
         self.adaptive = adaptive
 
         self._cv = threading.Condition()
-        self._queue: OrderedDict[tuple, _Pending] = OrderedDict()
+        # two FIFO lanes; dispatch packs live first, then backfill
+        self._queues: dict[str, OrderedDict[tuple, _Pending]] = {
+            lane: OrderedDict() for lane in LANES
+        }
         self._inflight: dict[tuple, _Pending] = {}
         self._cache: OrderedDict[tuple, bool] = OrderedDict()
         self._urgent = False
@@ -157,6 +176,12 @@ class VerifyHub:
             "cache_hits": 0.0,     # answered from the verdict LRU
             "coalesced": 0.0,      # joined an identical in-flight request
             "verify_errors": 0.0,  # batches whose verifier raised
+            # per-lane accounting (live packed ahead of backfill)
+            "lane_live_submitted": 0.0,
+            "lane_backfill_submitted": 0.0,
+            "lane_live_dispatched": 0.0,
+            "lane_backfill_dispatched": 0.0,
+            "lane_promotions": 0.0,  # backfill entries pulled into live
         }
 
     # -- lifecycle -------------------------------------------------------
@@ -197,13 +222,27 @@ class VerifyHub:
     # -- submission ------------------------------------------------------
 
     def submit_nowait(
-        self, pub_key: PubKey, msg: bytes, sig: bytes, *, urgent: bool = False
+        self,
+        pub_key: PubKey,
+        msg: bytes,
+        sig: bytes,
+        *,
+        urgent: bool = False,
+        lane: str = LANE_LIVE,
     ) -> Future:
         """Enqueue one verification; returns a concurrent Future[bool].
 
         `urgent` skips the micro-batch window (the batch still takes
         every request queued at dispatch time — urgency costs
-        coalescing-with-the-future, not coalescing-with-the-present)."""
+        coalescing-with-the-future, not coalescing-with-the-present).
+        `lane` picks the scheduler lane: live consensus is packed ahead
+        of backfill in every dispatch."""
+        if lane not in self._queues:
+            # a typo'd lane at a new call site must fail loudly — a
+            # silent fall-through to "live" would hand bulk catch-up
+            # traffic hot-path priority, the exact starvation the lanes
+            # exist to prevent
+            raise ValueError(f"unknown verify lane {lane!r}; use one of {LANES}")
         key = _cache_key(pub_key, msg, sig)
         fut: Future = Future()
         run_inline = False
@@ -214,10 +253,26 @@ class VerifyHub:
                 self._stats["cache_hits"] += 1
                 fut.set_result(verdict)
                 return fut
-            pending = self._queue.get(key) or self._inflight.get(key)
+            pending = (
+                self._queues[LANE_LIVE].get(key)
+                or self._queues[LANE_BACKFILL].get(key)
+                or self._inflight.get(key)
+            )
             if pending is not None:
                 pending.futures.append(fut)
                 self._stats["coalesced"] += 1
+                if (
+                    lane == LANE_LIVE
+                    and pending.lane == LANE_BACKFILL
+                    and pending.key in self._queues[LANE_BACKFILL]
+                ):
+                    # a live caller now waits on this triple: pull the
+                    # still-queued backfill entry into the live lane so
+                    # it stops queueing behind bulk catch-up traffic
+                    del self._queues[LANE_BACKFILL][pending.key]
+                    pending.lane = LANE_LIVE
+                    self._queues[LANE_LIVE][pending.key] = pending
+                    self._stats["lane_promotions"] += 1
                 if urgent:
                     self._urgent = True
                     self._cv.notify_all()
@@ -228,13 +283,17 @@ class VerifyHub:
                 # the lock
                 run_inline = True
             else:
-                self._queue[key] = _Pending(key, pub_key, msg, sig, fut, time.monotonic())
+                q = self._queues[lane]
+                q[key] = _Pending(
+                    key, pub_key, msg, sig, fut, time.monotonic(), lane
+                )
                 self._stats["submitted"] += 1
+                self._stats[f"lane_{lane}_submitted"] += 1
                 if urgent:
                     # head of the queue: a blocked caller (the consensus
                     # event loop) jumps any bulk backlog (block-sync
                     # commit groups) instead of waiting FIFO behind it
-                    self._queue.move_to_end(key, last=False)
+                    q.move_to_end(key, last=False)
                     self._urgent = True
                 self._cv.notify_all()
         if run_inline:
@@ -245,26 +304,44 @@ class VerifyHub:
         return fut
 
     def verify_sync(
-        self, pub_key: PubKey, msg: bytes, sig: bytes, timeout: float | None = 60.0
+        self,
+        pub_key: PubKey,
+        msg: bytes,
+        sig: bytes,
+        timeout: float | None = 60.0,
+        *,
+        lane: str = LANE_LIVE,
     ) -> bool:
         """Blocking facade for non-async callers (the consensus SM, the
         evidence pool). Urgent: a blocked caller can't generate more
         load, so waiting out the window would be pure added latency."""
-        return self.submit_nowait(pub_key, msg, sig, urgent=True).result(timeout)
+        return self.submit_nowait(pub_key, msg, sig, urgent=True, lane=lane).result(
+            timeout
+        )
 
-    async def verify(self, pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
+    async def verify(
+        self, pub_key: PubKey, msg: bytes, sig: bytes, *, lane: str = LANE_LIVE
+    ) -> bool:
         """Async API: awaits the batched verdict without blocking the
         event loop; concurrent awaiters coalesce into one dispatch."""
-        return await asyncio.wrap_future(self.submit_nowait(pub_key, msg, sig))
+        return await asyncio.wrap_future(
+            self.submit_nowait(pub_key, msg, sig, lane=lane)
+        )
 
     def verify_many(
-        self, items: list[tuple[PubKey, bytes, bytes]], timeout: float | None = 300.0
+        self,
+        items: list[tuple[PubKey, bytes, bytes]],
+        timeout: float | None = 300.0,
+        *,
+        lane: str = LANE_LIVE,
     ) -> list[bool]:
         """Submit a group (e.g. every signature of a commit) and wait for
         all verdicts. The group is flushed as one urgent dispatch — plus
         whatever else is queued, so concurrent commit verifications from
         different subsystems share kernel launches."""
-        futs = [self.submit_nowait(pk, msg, sig) for pk, msg, sig in items]
+        futs = [
+            self.submit_nowait(pk, msg, sig, lane=lane) for pk, msg, sig in items
+        ]
         self.flush()
         return [f.result(timeout) for f in futs]
 
@@ -287,7 +364,11 @@ class VerifyHub:
     def stats(self) -> dict:
         with self._cv:
             s = dict(self._stats)
-            s["queued"] = float(len(self._queue))
+            s["queued"] = float(
+                sum(len(q) for q in self._queues.values())
+            )
+            s["lane_live_queued"] = float(len(self._queues[LANE_LIVE]))
+            s["lane_backfill_queued"] = float(len(self._queues[LANE_BACKFILL]))
             s["cache_size"] = float(len(self._cache))
             s["mean_occupancy"] = (
                 s["dispatched_sigs"] / s["dispatches"] if s["dispatches"] else 0.0
@@ -315,13 +396,16 @@ class VerifyHub:
         frac = min(1.0, (occ - 1.0) / max(self.max_batch / 8.0, 1.0))
         return self.window_s * frac
 
+    def _queued(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
     def _dispatch_loop(self) -> None:
         self._worker_ids.add(threading.get_ident())
         while True:
             with self._cv:
-                while self._running and not self._queue:
+                while self._running and not self._queued():
                     self._cv.wait(0.2)
-                if not self._queue:
+                if not self._queued():
                     if not self._running:
                         return
                     continue
@@ -329,23 +413,33 @@ class VerifyHub:
                 # batch is device-sized, someone is blocked (urgent), or
                 # the hub is draining for shutdown
                 if self._running:
-                    oldest = next(iter(self._queue.values())).enqueued_at
+                    oldest = min(
+                        next(iter(q.values())).enqueued_at
+                        for q in self._queues.values()
+                        if q
+                    )
                     deadline = oldest + self._window()
                     while (
                         self._running
                         and not self._urgent
-                        and len(self._queue) < self.max_batch
+                        and self._queued() < self.max_batch
                     ):
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             break
                         self._cv.wait(remaining)
-                batch: list[_Pending] = []
-                while self._queue and len(batch) < self.max_batch:
-                    _, p = self._queue.popitem(last=False)
-                    self._inflight[p.key] = p
-                    batch.append(p)
-                if not self._queue:
+            # wait for an in-flight slot BEFORE packing (outside the
+            # lock: submitters must keep filling the lanes meanwhile).
+            # Packing as late as possible means a live/urgent arrival
+            # during a full double buffer still rides the VERY NEXT
+            # dispatch instead of waiting behind a pre-packed backfill
+            # batch — one whole batch less of tail latency. Only this
+            # thread pops the queues, so the batch cannot vanish between
+            # the window wait and the pack.
+            self._slots.acquire()
+            with self._cv:
+                batch = self._pack_batch()
+                if not self._queued():
                     self._urgent = False
                 now = time.monotonic()
                 for p in batch:
@@ -356,11 +450,23 @@ class VerifyHub:
                 self._ewma_occupancy = (
                     (1 - alpha) * self._ewma_occupancy + alpha * len(batch)
                 )
-            # hand off OUTSIDE the lock; while both buffers are full this
-            # blocks — submitters keep packing the queue meanwhile
-            self._slots.acquire()
+            # hand off outside the lock; the runner's done-callback
+            # frees the slot
             fut = self._runner.submit(self._run_batch, batch)
             fut.add_done_callback(lambda _f: self._slots.release())
+
+    def _pack_batch(self) -> list[_Pending]:
+        """Pop up to max_batch entries, live lane FIRST — catch-up
+        traffic can never displace the hot path. Caller holds _cv."""
+        batch: list[_Pending] = []
+        for lane in LANES:
+            q = self._queues[lane]
+            while q and len(batch) < self.max_batch:
+                _, p = q.popitem(last=False)
+                self._inflight[p.key] = p
+                batch.append(p)
+                self._stats[f"lane_{lane}_dispatched"] += 1
+        return batch
 
     def _run_batch(self, batch: list[_Pending]) -> None:
         self._worker_ids.add(threading.get_ident())
@@ -459,7 +565,9 @@ def running_hub() -> VerifyHub | None:
     return hub if hub is not None and hub.is_running else None
 
 
-def verify_one(pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
+def verify_one(
+    pub_key: PubKey, msg: bytes, sig: bytes, *, lane: str = LANE_LIVE
+) -> bool:
     """THE single-signature chokepoint (vote intake, proposal checks,
     evidence votes). Routes through the running hub — dedup cache +
     coalescing — and bypasses it when no hub is up. A hub stall or
@@ -470,7 +578,7 @@ def verify_one(pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
     if hub is None:
         return pub_key.verify_signature(msg, sig)
     try:
-        return hub.verify_sync(pub_key, msg, sig)
+        return hub.verify_sync(pub_key, msg, sig, lane=lane)
     except Exception as e:  # noqa: BLE001 — timeout/shutdown races
         logger.warning("hub verify failed (%r); verifying inline", e)
         return pub_key.verify_signature(msg, sig)

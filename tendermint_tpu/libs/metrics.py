@@ -241,6 +241,22 @@ class NodeMetrics:
         self.verifyhub_cache_hit_rate = r.gauge(
             "verifyhub", "cache_hit_rate", "fraction of requests served from cache"
         )
+        # two-lane scheduler (live consensus packed ahead of catch-up
+        # backfill in every micro-batch); series carry a lane label
+        self.verifyhub_lane_submitted = r.counter(
+            "verifyhub", "lane_submitted", "unique triples enqueued per lane"
+        )
+        self.verifyhub_lane_sigs = r.counter(
+            "verifyhub", "lane_sigs_dispatched", "signatures dispatched per lane"
+        )
+        self.verifyhub_lane_queued = r.gauge(
+            "verifyhub", "lane_queued", "triples currently queued per lane"
+        )
+        self.verifyhub_lane_promotions = r.counter(
+            "verifyhub",
+            "lane_promotions",
+            "queued backfill entries pulled into the live lane by a live coalesce",
+        )
         # bucket layout shared with the hub's live histogram (one source
         # of truth — _fold_verify_hub copies counts index-for-index)
         from ..crypto.verify_hub import LATENCY_BUCKETS
@@ -249,6 +265,43 @@ class NodeMetrics:
             "verifyhub",
             "queue_latency_seconds",
             "submit-to-dispatch wait per request",
+            buckets=LATENCY_BUCKETS,
+        )
+        # pipelined consensus ingest (consensus/ingest.py — per-CS
+        # pipelines registered process-wide, folded in at render time)
+        self.consensus_ingest_inflight = r.gauge(
+            "consensus_ingest",
+            "inflight",
+            "messages submitted to the ingest pipeline and not yet applied",
+        )
+        self.consensus_ingest_submitted = r.counter(
+            "consensus_ingest", "submitted", "messages entering stage-1 verify"
+        )
+        self.consensus_ingest_released = r.counter(
+            "consensus_ingest",
+            "released",
+            "messages released in arrival order to the state machine",
+        )
+        self.consensus_ingest_dedup_drops = r.counter(
+            "consensus_ingest",
+            "dedup_drops",
+            "gossip duplicates dropped against the vote-set before verification",
+        )
+        self.consensus_ingest_pre_verified = r.counter(
+            "consensus_ingest",
+            "pre_verified",
+            "messages whose signature was proven in stage 1 (not re-checked at apply)",
+        )
+        self.consensus_ingest_verify_latency = r.histogram(
+            "consensus_ingest",
+            "verify_latency_seconds",
+            "stage-1 intake-to-verdict wait per message",
+            buckets=LATENCY_BUCKETS,
+        )
+        self.consensus_ingest_reorder_wait = r.histogram(
+            "consensus_ingest",
+            "reorder_wait_seconds",
+            "verdict-to-in-order-release wait per message",
             buckets=LATENCY_BUCKETS,
         )
         # abci
@@ -270,6 +323,15 @@ class NodeMetrics:
         self.verifyhub_occupancy.set(round(s["mean_occupancy"], 3))
         self.verifyhub_dispatch_rate.set(round(s["dispatch_rate"], 3))
         self.verifyhub_cache_hit_rate.set(round(s["cache_hit_rate"], 4))
+        for lane in ("live", "backfill"):
+            self.verifyhub_lane_submitted._values[(("lane", lane),)] = s[
+                f"lane_{lane}_submitted"
+            ]
+            self.verifyhub_lane_sigs._values[(("lane", lane),)] = s[
+                f"lane_{lane}_dispatched"
+            ]
+            self.verifyhub_lane_queued.set(s[f"lane_{lane}_queued"], lane=lane)
+        self.verifyhub_lane_promotions._values[()] = s["lane_promotions"]
         # consistent snapshot taken under the hub lock (a mid-copy
         # dispatch would otherwise skew _count against the bucket sums)
         counts, sum_, count = hub.latency_snapshot()
@@ -278,6 +340,27 @@ class NodeMetrics:
             dst._counts = counts
             dst._sum = sum_
             dst._count = count
+
+    def _fold_ingest(self) -> None:
+        from ..consensus import ingest
+
+        s, verify_hist, reorder_hist = ingest.aggregate()
+        if s is None:
+            return
+        self.consensus_ingest_inflight.set(s["inflight"])
+        self.consensus_ingest_submitted._values[()] = s["submitted"]
+        self.consensus_ingest_released._values[()] = s["released"]
+        self.consensus_ingest_dedup_drops._values[()] = s["dedup_drops"]
+        self.consensus_ingest_pre_verified._values[()] = s["pre_verified"]
+        for src, dst in (
+            (verify_hist, self.consensus_ingest_verify_latency),
+            (reorder_hist, self.consensus_ingest_reorder_wait),
+        ):
+            counts, sum_, count = src
+            if len(counts) == len(dst._counts):  # same LATENCY_BUCKETS layout
+                dst._counts = counts
+                dst._sum = sum_
+                dst._count = count
 
     def render(self) -> str:
         # fold the process-wide resilience events in at scrape time
@@ -289,6 +372,7 @@ class NodeMetrics:
         self.wal_repairs._values[()] = STORAGE["wal_repairs"]
         self.wal_truncated_bytes._values[()] = STORAGE["wal_truncated_bytes"]
         self._fold_verify_hub()
+        self._fold_ingest()
         return self.registry.render()
 
 

@@ -155,6 +155,7 @@ class LightClient:
             lb.signed_header.commit.block_id,
             lb.height,
             lb.signed_header.commit,
+            lane="backfill",
         )
         self.store.save(lb)
         return lb
@@ -327,6 +328,7 @@ class LightClient:
                     w_lb.signed_header.commit.block_id,
                     w_lb.height,
                     w_lb.signed_header.commit,
+                    lane="backfill",
                 )
             except (ValueError, VerificationError):
                 self.logger.info("dropping bad witness %r", witness)

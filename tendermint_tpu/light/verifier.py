@@ -108,6 +108,7 @@ def verify_adjacent(
             untrusted.signed_header.commit.block_id,
             untrusted.height,
             untrusted.signed_header.commit,
+            lane="backfill",
         )
     except InvalidCommitError as e:
         raise VerificationError(f"invalid commit: {e}") from e
@@ -155,7 +156,7 @@ def verify_adjacent_chain(
         )
         prev = lb
     try:
-        verify_commit_range(chain_id, entries)
+        verify_commit_range(chain_id, entries, lane="backfill")
     except InvalidCommitError as e:
         idx = getattr(e, "failed_index", None)
         at = f" at height {chain[idx].height}" if idx is not None else ""
@@ -189,6 +190,7 @@ def verify_non_adjacent(
             trusted.validators,
             untrusted.signed_header.commit,
             trust_level,
+            lane="backfill",
         )
     except InvalidCommitError as e:
         raise ErrNewValSetCantBeTrusted(str(e)) from e
@@ -200,6 +202,7 @@ def verify_non_adjacent(
             untrusted.signed_header.commit.block_id,
             untrusted.height,
             untrusted.signed_header.commit,
+            lane="backfill",
         )
     except InvalidCommitError as e:
         raise VerificationError(f"invalid commit: {e}") from e

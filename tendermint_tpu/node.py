@@ -110,7 +110,12 @@ class NodeConfig:
     clock: object | None = None
     # VerifyHub (crypto/verify_hub.py): the node acquires the process
     # hub on start and releases it on stop; every vote/proposal/commit
-    # signature then routes through the micro-batching scheduler
+    # signature then routes through the micro-batching scheduler. Live
+    # consensus submits on the "live" lane and is packed ahead of
+    # block-sync/state-sync "backfill" in every micro-batch; the
+    # consensus receive path feeds it through the pipelined ingest
+    # (consensus/ingest.py, ConsensusConfig.ingest_*) so many
+    # verifications overlap per node.
     verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
 
 
@@ -418,6 +423,15 @@ class Node(Service):
             mempool=self.mempool,
             clock=clock,
         )
+        if self.consensus.ingest is not None:
+            # two-stage pipelined ingest (consensus/ingest.py): only pays
+            # off when the async hub API has a hub to feed — without one
+            # stage 1 degrades to an ordered pass-through
+            self.logger.info(
+                "consensus ingest pipeline enabled (max_inflight=%d, hub=%s)",
+                self.consensus.ingest.max_inflight,
+                "on" if self.verify_hub is not None else "off",
+            )
         self.cs_reactor = ConsensusReactor(
             self.consensus,
             self.state_ch,

@@ -31,17 +31,29 @@ class VerifyChokepoint(Rule):
     id = "verify-chokepoint"
     doc = (
         "no direct *.verify_signature() outside the crypto/handshake/"
-        "harness allowlist — route through crypto/verify_hub"
+        "harness allowlist — route through crypto/verify_hub; and no "
+        "sync-facade verification (verify_sync / submit_nowait().result())"
+        " inside coroutines in consensus/blocksync/statesync"
     )
     scope = ("tendermint_tpu/",)
     profiles = ("node",)
 
+    #: dirs where the pipelined ingest made the SYNC hub facade inside a
+    #: coroutine a defect: it blocks the event loop on one signature and
+    #: pins batch occupancy at 1 — use `await hub.verify(...)` (or hand
+    #: the work to the ingest pipeline / asyncio.to_thread)
+    ASYNC_SCOPES = (
+        "tendermint_tpu/consensus/",
+        "tendermint_tpu/blocksync/",
+        "tendermint_tpu/statesync/",
+    )
+
     def check(self, ctx: FileContext) -> Iterable[Finding]:
+        in_async_scope = any(ctx.rel.startswith(p) for p in self.ASYNC_SCOPES)
         for node in ast.walk(ctx.tree):
-            if (
-                isinstance(node, ast.Call)
-                and method_name(node) == "verify_signature"
-            ):
+            if not isinstance(node, ast.Call):
+                continue
+            if method_name(node) == "verify_signature":
                 yield ctx.finding(
                     self.id,
                     node,
@@ -50,6 +62,36 @@ class VerifyChokepoint(Rule):
                     "north star); route through crypto/verify_hub.verify_one "
                     "or the validation batch shim",
                 )
+                continue
+            if not (in_async_scope and ctx.in_async_def(node)):
+                continue
+            if method_name(node) == "verify_sync":
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    "hub.verify_sync() inside a coroutine blocks the event "
+                    "loop on ONE signature and pins batch occupancy at 1 — "
+                    "await the async hub.verify() (the pipelined-ingest "
+                    "path) instead",
+                )
+            elif method_name(node) == "result" and self._submit_receiver(node):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    "submit_nowait(...).result() inside a coroutine is the "
+                    "sync facade in disguise (blocks the loop per "
+                    "signature); await asyncio.wrap_future(...) or the "
+                    "async hub.verify() instead",
+                )
+
+    @staticmethod
+    def _submit_receiver(node: ast.Call) -> bool:
+        """True for `<expr>.submit_nowait(...).result(...)` chains."""
+        recv = node.func.value  # method_name() proved func is Attribute
+        return (
+            isinstance(recv, ast.Call)
+            and method_name(recv) == "submit_nowait"
+        )
 
 
 class FsDiscipline(Rule):

@@ -42,10 +42,13 @@ class _CommitVerifier:
     running (cross-subsystem micro-batching + gossip-duplicate dedup),
     and otherwise through the local `create_batch_verifier` path — the
     verdicts are identical, the hub only changes where/when the batch
-    launches."""
+    launches. `lane` picks the hub scheduler lane: block-sync /
+    state-sync / light-client callers submit as "backfill" so bulk
+    catch-up ranges never starve live consensus."""
 
-    def __init__(self, pub_key):
+    def __init__(self, pub_key, lane: str = "live"):
         self._pub_key = pub_key
+        self._lane = lane
         self._items: list[tuple] = []
 
     def add(self, pub_key, msg: bytes, sig: bytes) -> None:
@@ -57,7 +60,7 @@ class _CommitVerifier:
         hub = running_hub()
         if hub is not None:
             try:
-                results = hub.verify_many(self._items)
+                results = hub.verify_many(self._items, lane=self._lane)
                 return all(results) and bool(results), results
             except Exception as e:  # noqa: BLE001 — stall/shutdown races
                 # same contract as verify_one: a wedged hub costs
@@ -98,6 +101,8 @@ def verify_commit(
     block_id: BlockID,
     height: int,
     commit: Commit,
+    *,
+    lane: str = "live",
 ) -> None:
     """Full commit verification (reference types/validation.go:25).
     Raises InvalidCommitError on failure."""
@@ -110,6 +115,7 @@ def verify_commit(
         voting_power_needed,
         count_all_signatures=True,
         lookup_by_index=True,
+        lane=lane,
     )
 
 
@@ -119,6 +125,8 @@ def verify_commit_light(
     block_id: BlockID,
     height: int,
     commit: Commit,
+    *,
+    lane: str = "live",
 ) -> None:
     """Verify only the signatures for the committed block, stopping at +2/3
     (reference types/validation.go:59) — the block-sync/light-client path."""
@@ -131,6 +139,7 @@ def verify_commit_light(
         voting_power_needed,
         count_all_signatures=False,
         lookup_by_index=True,
+        lane=lane,
     )
 
 
@@ -139,6 +148,8 @@ def verify_commit_light_trusting(
     vals: ValidatorSet,
     commit: Commit,
     trust_level: Fraction = Fraction(1, 3),
+    *,
+    lane: str = "live",
 ) -> None:
     """Light-client skipping verification against a *trusted* validator set
     (reference types/validation.go:94): validators are matched by address
@@ -155,6 +166,7 @@ def verify_commit_light_trusting(
         voting_power_needed,
         count_all_signatures=False,
         lookup_by_index=False,
+        lane=lane,
     )
 
 
@@ -165,14 +177,17 @@ def _verify(
     voting_power_needed: int,
     count_all_signatures: bool,
     lookup_by_index: bool,
+    lane: str = "live",
 ) -> None:
     if _should_batch_verify(vals, commit):
         _verify_batch(
-            chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+            chain_id, vals, commit, voting_power_needed, count_all_signatures,
+            lookup_by_index, lane=lane,
         )
     else:
         _verify_single(
-            chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+            chain_id, vals, commit, voting_power_needed, count_all_signatures,
+            lookup_by_index, lane=lane,
         )
 
 
@@ -199,9 +214,10 @@ def _iter_entries(vals: ValidatorSet, commit: Commit, lookup_by_index: bool):
 
 
 def _verify_batch(
-    chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+    chain_id, vals, commit, voting_power_needed, count_all_signatures,
+    lookup_by_index, lane="live",
 ) -> None:
-    bv = _CommitVerifier(vals.validators[0].pub_key)
+    bv = _CommitVerifier(vals.validators[0].pub_key, lane=lane)
     tallied = 0
     added = 0
     entries = []
@@ -233,6 +249,8 @@ def _verify_batch(
 def verify_commit_range(
     chain_id: str,
     entries: list[tuple[ValidatorSet, BlockID, int, Commit]],
+    *,
+    lane: str = "backfill",
 ) -> None:
     """Cross-commit mega-batching (SURVEY.md §5 "long-context" analog):
     verify a RANGE of commits — e.g. a block-sync window — in ONE batch
@@ -259,10 +277,12 @@ def verify_commit_range(
             _basic_commit_checks(vals, block_id, height, commit)
             if not _should_batch_verify(vals, commit):
                 # mixed/secp256k1 sets: verify this one individually
-                verify_commit_light(chain_id, vals, block_id, height, commit)
+                verify_commit_light(
+                    chain_id, vals, block_id, height, commit, lane=lane
+                )
                 continue
             if bv is None:
-                bv = _CommitVerifier(vals.validators[0].pub_key)
+                bv = _CommitVerifier(vals.validators[0].pub_key, lane=lane)
             voting_power_needed = vals.total_voting_power() * 2 // 3
             tallied = 0
             for idx, cs, val in _iter_entries(vals, commit, lookup_by_index=True):
@@ -289,7 +309,7 @@ def verify_commit_range(
     # locate the offending commit: per-commit fallback
     for ei, (vals, block_id, height, commit) in enumerate(entries):
         try:
-            verify_commit_light(chain_id, vals, block_id, height, commit)
+            verify_commit_light(chain_id, vals, block_id, height, commit, lane=lane)
         except InvalidCommitError as e:
             e.failed_index = ei
             raise
@@ -297,7 +317,8 @@ def verify_commit_range(
 
 
 def _verify_single(
-    chain_id, vals, commit, voting_power_needed, count_all_signatures, lookup_by_index
+    chain_id, vals, commit, voting_power_needed, count_all_signatures,
+    lookup_by_index, lane="live",
 ) -> None:
     from ..crypto.verify_hub import verify_one
 
@@ -306,7 +327,8 @@ def _verify_single(
         if not count_all_signatures and not cs.is_commit():
             continue
         if not verify_one(
-            val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature
+            val.pub_key, commit.vote_sign_bytes(chain_id, idx), cs.signature,
+            lane=lane,
         ):
             raise InvalidCommitError(f"invalid signature at index {idx}")
         if cs.is_commit():

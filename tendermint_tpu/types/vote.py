@@ -29,14 +29,17 @@ class Vote:
         )
 
     def verify(self, chain_id: str, pub_key) -> bool:
-        """Single-vote verification — the consensus per-vote hot path
-        (reference types/vote.go:147). Routes through the VerifyHub when
-        one is running. The single-node win here is the verdict CACHE —
-        the same vote arriving from many peers (gossip) verifies once;
-        coalescing into shared batches additionally kicks in whenever
-        other threads/loops are submitting concurrently (commit groups,
-        multi-node processes). This is the adoption point for BOTH
-        VoteSet.add_vote and the evidence pool's vote checks."""
+        """Single-vote verification (reference types/vote.go:147).
+        Routes through the VerifyHub's SYNC facade when one is running.
+
+        Since the pipelined ingest landed this is the *fallback* path:
+        peer votes normally arrive at `VoteSet.add_vote` already proven
+        by stage 1 of consensus/ingest.py (the async `hub.verify` API,
+        many in flight per node) and skip this call entirely. What still
+        funnels through here: our own freshly signed votes, the evidence
+        pool's checks, replay, and any vote the pipeline could not
+        attribute to a validator set. The hub's verdict cache then makes
+        a repeat check (the same vote from many peers) free."""
         if pub_key.address() != self.validator_address:
             return False
         from ..crypto.verify_hub import verify_one

@@ -52,10 +52,16 @@ class VoteSet:
     def size(self) -> int:
         return len(self.val_set)
 
-    def add_vote(self, vote: Vote) -> bool:
+    def add_vote(self, vote: Vote, *, verified: bool = False) -> bool:
         """Validate + add a vote. Returns True if added; raises on invalid
         votes; raises ConflictingVoteError on an equivocation (the caller
-        turns it into DuplicateVoteEvidence)."""
+        turns it into DuplicateVoteEvidence).
+
+        `verified=True` is the pre-verified-vote path: the pipelined
+        ingest (consensus/ingest.py) already proved this exact vote's
+        signature against the pubkey this set resolves for its index,
+        so the apply-time re-check is skipped. Index/address identity
+        and conflict detection still run unconditionally."""
         if vote is None:
             raise VoteSetError("nil vote")
         if (
@@ -80,7 +86,7 @@ class VoteSet:
                 return False  # duplicate, not an error
             raise ConflictingVoteError(existing, vote)
 
-        if not vote.verify(self.chain_id, val.pub_key):
+        if not verified and not vote.verify(self.chain_id, val.pub_key):
             raise VoteSetError(f"invalid signature from validator {idx}")
 
         self.votes[idx] = vote

@@ -361,6 +361,52 @@ def test_verify_signature_interface_def_clean():
     assert run(src, "verify-chokepoint", rel="tendermint_tpu/types/keys.py") == []
 
 
+def test_sync_facade_in_coroutine_flagged():
+    """The pipelined ingest made the hub's SYNC facade inside a
+    coroutine a lint error in consensus/blocksync/statesync: it blocks
+    the event loop per signature and pins batch occupancy at 1."""
+    src = """
+    async def handle(self, vote):
+        ok = self.hub.verify_sync(pk, msg, sig)
+        ok2 = self.hub.submit_nowait(pk, msg, sig).result(5.0)
+    """
+    fs = run(src, "verify-chokepoint", rel="tendermint_tpu/consensus/ingest.py")
+    assert len(fs) == 2
+    assert "blocks the event loop" in fs[0].message
+    assert "sync facade in disguise" in fs[1].message
+    # same pattern in blocksync is equally flagged
+    assert len(run(src, "verify-chokepoint", rel="tendermint_tpu/blocksync/pool.py")) == 2
+
+
+def test_sync_facade_clean_cases():
+    # sync defs may block (the evidence pool, replay); the async hub API
+    # is the blessed path; .result() on other receivers is untouched
+    src = """
+    def sync_check(self, pk, msg, sig):
+        return self.hub.verify_sync(pk, msg, sig)
+    async def pipelined(self, pk, msg, sig):
+        return await self.hub.verify(pk, msg, sig)
+    async def other_future(self):
+        return self.pool.submit(job).result()
+    """
+    assert run(src, "verify-chokepoint", rel="tendermint_tpu/consensus/state.py") == []
+    # outside consensus/blocksync/statesync the facade stays legal (the
+    # evidence pool and validation shim are synchronous by design)
+    flagged = """
+    async def handle(self):
+        return self.hub.verify_sync(pk, msg, sig)
+    """
+    assert run(flagged, "verify-chokepoint", rel="tendermint_tpu/types/validation.py") == []
+
+
+def test_sync_facade_pragma_escape_hatch():
+    src = """
+    async def handle(self):
+        return self.hub.verify_sync(pk, msg, sig)  # tmtlint: allow[verify-chokepoint] -- measured: cache hit path only
+    """
+    assert run(src, "verify-chokepoint", rel="tendermint_tpu/consensus/state.py") == []
+
+
 def test_crypto_backends_allowlisted():
     src = """
     def check(pk, msg, sig):
