@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""tracectl — summarize flight-recorder dumps into per-stage latency
+tables.
+
+Input: the JSON served by a node's ``/debug/traces`` endpoint (or a
+flight auto-dump file written on wedge/breaker-trip) — either shape is
+accepted: ``{"spans": [...]}`` wrappers or a bare span list.
+
+    python scripts/tracectl.py dump.json            # per-stage table
+    curl -s localhost:26657/debug/traces | python scripts/tracectl.py -
+    python scripts/tracectl.py dump.json --trace 42 # one trace, in order
+    python scripts/tracectl.py dump.json --subsystem hub
+
+The per-stage table answers the ROADMAP question ("where did this vote
+spend its time?") in aggregate: count, p50, p90, p99, max, and total
+time per (subsystem, name) stage. ``--trace`` prints one end-to-end
+trace's spans in start order so a single message's life is readable
+top to bottom.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_spans(path: str) -> list[dict]:
+    if path == "-":
+        data = json.load(sys.stdin)
+    else:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    if isinstance(data, dict):
+        data = data.get("spans", [])
+    if not isinstance(data, list):
+        raise ValueError("expected a span list or a {'spans': [...]} object")
+    return data
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile on a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def summarize(spans: list[dict]) -> str:
+    """Per-stage latency table (the shape the acceptance run reads)."""
+    stages: dict[str, list[float]] = {}
+    for s in spans:
+        key = f"{s.get('subsystem', '?')}.{s.get('name', '?')}"
+        stages.setdefault(key, []).append(float(s.get("duration_ms", 0.0)))
+    if not stages:
+        return "no spans"
+    rows = []
+    for key, vals in stages.items():
+        vals.sort()
+        rows.append(
+            (
+                key,
+                len(vals),
+                _pct(vals, 0.50),
+                _pct(vals, 0.90),
+                _pct(vals, 0.99),
+                vals[-1],
+                sum(vals),
+            )
+        )
+    rows.sort(key=lambda r: -r[6])  # biggest total time first
+    header = f"{'stage':<28} {'count':>7} {'p50ms':>9} {'p90ms':>9} {'p99ms':>9} {'maxms':>9} {'totalms':>10}"
+    lines = [header, "-" * len(header)]
+    for key, n, p50, p90, p99, mx, total in rows:
+        lines.append(
+            f"{key:<28} {n:>7} {p50:>9.3f} {p90:>9.3f} {p99:>9.3f} "
+            f"{mx:>9.3f} {total:>10.2f}"
+        )
+    return "\n".join(lines)
+
+
+def render_trace(spans: list[dict], trace_id: int) -> str:
+    """One trace's spans in start order — a message's life, top down."""
+    mine = [s for s in spans if s.get("trace_id") == trace_id]
+    if not mine:
+        return f"no spans for trace {trace_id}"
+    mine.sort(key=lambda s: (s.get("start_s", 0.0), -s.get("duration_ms", 0.0)))
+    t0 = mine[0].get("start_s", 0.0)
+    lines = [f"trace {trace_id} ({len(mine)} spans):"]
+    for s in mine:
+        at = (s.get("start_s", 0.0) - t0) * 1e3
+        attrs = s.get("attrs") or {}
+        extra = " ".join(f"{k}={v}" for k, v in attrs.items())
+        lines.append(
+            f"  +{at:9.3f}ms {s.get('subsystem','?')}.{s.get('name','?'):<18} "
+            f"{s.get('duration_ms', 0.0):9.3f}ms  {extra}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", help="dump file path, or - for stdin")
+    ap.add_argument("--subsystem", help="only this subsystem's spans")
+    ap.add_argument("--trace", type=int, help="print one trace in start order")
+    args = ap.parse_args(argv)
+    try:
+        spans = load_spans(args.dump)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"tracectl: cannot read {args.dump}: {e}", file=sys.stderr)
+        return 2
+    if args.subsystem:
+        spans = [s for s in spans if s.get("subsystem") == args.subsystem]
+    if args.trace is not None:
+        print(render_trace(spans, args.trace))
+    else:
+        print(summarize(spans))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

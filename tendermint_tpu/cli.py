@@ -147,6 +147,10 @@ def _build_node(home: str):
             f"unknown proxy app {cfg.proxy_app!r} "
             "(builtin: kvstore; remote: tcp://host:port, grpc://host:port)"
         )
+    if cfg.trace.enabled and not cfg.trace.dump_dir:
+        # real nodes get their flight auto-dumps next to the watchdog's
+        # stack bundles unless the operator pointed them elsewhere
+        cfg.trace.dump_dir = os.path.join(p["data"], "debug")
     state_sync = None
     if cfg.statesync.enable and cfg.statesync.trust_hash:
         state_sync = SyncConfig(
@@ -170,6 +174,7 @@ def _build_node(home: str):
         chaos=cfg.chaos,
         chaos_fs=cfg.chaos_fs,
         verify_hub=cfg.verify_hub,
+        trace=cfg.trace,
     )
     transport = TCPTransport(
         send_rate=cfg.p2p.send_rate, recv_rate=cfg.p2p.recv_rate

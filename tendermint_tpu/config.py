@@ -155,6 +155,20 @@ class VerifyHubConfig:
 
 
 @dataclass
+class TraceConfig:
+    """Flight-recorder tracing (libs/trace.py): structured spans over
+    the verify funnel landing in a bounded per-process ring buffer,
+    served at /debug/traces and dumped automatically on wedge/breaker
+    trip. Env mirrors win over TOML: TMTPU_TRACE=0 disables,
+    TMTPU_TRACE_RING sizes the ring, TMTPU_TRACE_DIR points auto-dumps
+    at a directory."""
+
+    enabled: bool = True
+    ring_size: int = 4096  # spans kept; oldest dropped when full
+    dump_dir: str = ""  # where auto-dumps land; empty = in-memory only
+
+
+@dataclass
 class StateSyncConfig:
     """Reference config statesync section."""
 
@@ -188,6 +202,7 @@ class Config:
     chaos: ChaosNetConfig = field(default_factory=ChaosNetConfig)
     chaos_fs: ChaosFSConfig = field(default_factory=ChaosFSConfig)
     verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
 
 def _section_to_toml(name: str, obj) -> str:
@@ -226,6 +241,8 @@ def config_to_toml(cfg: Config) -> str:
         "",
         _section_to_toml("verify_hub", cfg.verify_hub),
         "",
+        _section_to_toml("trace", cfg.trace),
+        "",
     ]
     return "\n".join(parts)
 
@@ -250,6 +267,7 @@ def config_from_toml(text: str) -> Config:
         ("chaos", cfg.chaos),
         ("chaos_fs", cfg.chaos_fs),
         ("verify_hub", cfg.verify_hub),
+        ("trace", cfg.trace),
     ):
         for k, v in data.get(section, {}).items():
             if hasattr(obj, k):

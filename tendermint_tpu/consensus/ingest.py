@@ -53,6 +53,7 @@ import logging
 import weakref
 from dataclasses import replace
 
+from ..libs import trace
 from ..libs.metrics import Histogram
 from ..types.keys import SignedMsgType
 from . import messages as m
@@ -118,8 +119,9 @@ class IngestPipeline:
         # holds strictly ascending seqs because submit() never awaits
         # between seq assignment and put_nowait
         self._intake: asyncio.Queue = asyncio.Queue()
-        # seq -> (verdict_done_at, MsgInfo | None); None = dropped in stage 1
-        self._buf: dict[int, tuple[float, object | None]] = {}
+        # seq -> (verdict_done_at, MsgInfo | None, TraceCtx | None);
+        # MsgInfo None = dropped in stage 1
+        self._buf: dict[int, tuple[float, object | None, object | None]] = {}
         self._next_submit = 0
         self._next_release = 0
         self._completed = asyncio.Event()
@@ -177,7 +179,15 @@ class IngestPipeline:
         seq = self._next_submit
         self._next_submit += 1
         self.stats["submitted"] += 1
-        self._intake.put_nowait((seq, self.cs.clock.monotonic(), mi))
+        t0 = self.cs.clock.monotonic()
+        # flight-recorder trace: adopt the reactor-opened context (which
+        # already carries the p2p.receive span) or open one here for
+        # harness-injected messages; the "submit" mark anchors the
+        # end-to-end ingest span the SM closes at apply time
+        ctx = mi.trace if mi.trace is not None else trace.start(self.cs.clock)
+        if ctx is not None:
+            ctx.marks["submit"] = t0
+        self._intake.put_nowait((seq, t0, mi, ctx))
 
     # -- stage 1: concurrent verify --------------------------------------
 
@@ -189,10 +199,12 @@ class IngestPipeline:
             # max_inflight — and a worker that always drains intake
             # can never deadlock against a release loop stalled on a
             # seq still sitting in the queue
-            seq, t0, mi = await self._intake.get()
+            seq, t0, mi, ctx = await self._intake.get()
+            t_start = self.cs.clock.monotonic()
+            trace.record(ctx, "consensus", "ingest.wait", t0, t_start)
             out = mi
             try:
-                out = await self._classify(mi)
+                out = await self._classify(mi, ctx)
             except asyncio.CancelledError:
                 raise
             except Exception as e:  # noqa: BLE001 — degrade, never wedge
@@ -204,22 +216,27 @@ class IngestPipeline:
                 out = mi
             now = self.cs.clock.monotonic()
             self.verify_latency.observe(max(0.0, now - t0))
-            self._buf[seq] = (now, out)
+            trace.record(
+                ctx, "consensus", "ingest.verify", t_start, now,
+                sig_ok=getattr(out, "sig_ok", None) if out is not None else None,
+                dropped=out is None,
+            )
+            self._buf[seq] = (now, out, ctx)
             self._completed.set()
 
-    async def _classify(self, mi):
+    async def _classify(self, mi, ctx=None):
         """Returns the (possibly sig_ok-annotated) MsgInfo to release,
         or None to drop the message in stage 1."""
         msg = mi.msg
         if isinstance(msg, m.VoteMessage):
-            return await self._classify_vote(mi, msg.vote)
+            return await self._classify_vote(mi, msg.vote, ctx)
         if isinstance(msg, m.ProposalMessage):
-            return await self._classify_proposal(mi, msg.proposal)
+            return await self._classify_proposal(mi, msg.proposal, ctx)
         # block parts & friends carry no signature of their own; they
         # still ride the reorder buffer so arrival order is preserved
         return mi
 
-    async def _classify_vote(self, mi, vote):
+    async def _classify_vote(self, mi, vote, ctx=None):
         try:
             vote.validate_basic()
         except ValueError as e:
@@ -240,7 +257,7 @@ class IngestPipeline:
             return mi
         chain_id = self.cs.state.chain_id
         ok = await self._hub_verify(
-            pub, vote.sign_bytes(chain_id), vote.signature
+            pub, vote.sign_bytes(chain_id), vote.signature, ctx
         )
         if ok is None:
             self.stats["unverified"] += 1
@@ -248,7 +265,7 @@ class IngestPipeline:
         self.stats["pre_verified" if ok else "sig_invalid"] += 1
         return replace(mi, sig_ok=ok)
 
-    async def _classify_proposal(self, mi, proposal):
+    async def _classify_proposal(self, mi, proposal, ctx=None):
         rs = self.cs.rs
         # only pre-verify when the proposal targets the CURRENT (height,
         # round): the proposer is then pinned, and if the round moves on
@@ -267,7 +284,7 @@ class IngestPipeline:
             return mi  # apply raises/logs identically to the sync path
         pub = rs.validators.get_proposer().pub_key
         ok = await self._hub_verify(
-            pub, proposal.sign_bytes(self.cs.state.chain_id), proposal.signature
+            pub, proposal.sign_bytes(self.cs.state.chain_id), proposal.signature, ctx
         )
         if ok is None:
             self.stats["unverified"] += 1
@@ -322,7 +339,7 @@ class IngestPipeline:
             return None
         return val.pub_key
 
-    async def _hub_verify(self, pub, sign_bytes, sig):
+    async def _hub_verify(self, pub, sign_bytes, sig, ctx=None):
         """Async hub verdict, or None when no hub is running / the hub
         errored (the apply-time check then decides — a wedged hub costs
         latency, never consensus progress)."""
@@ -332,7 +349,9 @@ class IngestPipeline:
         if hub is None:
             return None
         try:
-            return await hub.verify(pub, sign_bytes, sig, lane=LANE_LIVE)
+            return await hub.verify(
+                pub, sign_bytes, sig, lane=LANE_LIVE, trace_ctx=ctx
+            )
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — shutdown/stall races
@@ -349,14 +368,18 @@ class IngestPipeline:
             await self._completed.wait()
             self._completed.clear()
             while self._next_release in self._buf:
-                done_at, out = self._buf.pop(self._next_release)
+                done_at, out, ctx = self._buf.pop(self._next_release)
                 self._next_release += 1
                 if out is None:
                     self._sem.release()
                     continue  # dropped in stage 1 (dup / malformed)
-                self.reorder_wait.observe(
-                    max(0.0, self.cs.clock.monotonic() - done_at)
-                )
+                t_rel = self.cs.clock.monotonic()
+                self.reorder_wait.observe(max(0.0, t_rel - done_at))
+                if ctx is not None:
+                    trace.record(ctx, "consensus", "ingest.reorder", done_at, t_rel)
+                    ctx.marks["release"] = t_rel
+                    if out.trace is None:
+                        out = replace(out, trace=ctx)
                 self.stats["released"] += 1
                 # put BEFORE releasing the permit: a stalled SM (full
                 # msg_queue) keeps the in-flight bound strict

@@ -16,6 +16,8 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from ..libs import trace
+from ..libs.clock import SYSTEM
 from ..libs.service import Service
 from ..p2p.peermanager import PeerStatus
 from ..p2p.router import Channel
@@ -201,6 +203,29 @@ class ConsensusReactor(Service):
             ),
         )
 
+    def _start_trace(self, env):
+        """Open the end-to-end trace at the gossip edge. The router
+        stamped `env.recv_at` as the bytes came off the wire; the
+        p2p.receive span (recorded by the caller after the hand-off)
+        therefore covers decode + channel-queue wait + ingest
+        backpressure."""
+        return trace.start(self.cs.clock)
+
+    def _finish_receive(self, ctx, env, channel: str) -> None:
+        if ctx is None:
+            return
+        # recv_at was stamped by the router on the SYSTEM monotonic
+        # domain; this node's clock may be rate-scaled (chaos drift), so
+        # measure the duration purely in the SYSTEM domain and anchor it
+        # ending at the trace clock's "now" — mixing the two domains in
+        # one subtraction would corrupt the duration by (rate-1)*uptime
+        now = self.cs.clock.monotonic()
+        dur = max(0.0, SYSTEM.monotonic() - env.recv_at) if env.recv_at else 0.0
+        trace.record(
+            ctx, "p2p", "receive", now - dur, now,
+            channel=channel, peer=env.from_[:8],
+        )
+
     async def _process_data_ch(self) -> None:
         async for env in self.data_ch:
             ps = self.peers.get(env.from_)
@@ -209,14 +234,20 @@ class ConsensusReactor(Service):
                 if isinstance(msg, m.ProposalMessage):
                     if ps is not None:
                         ps.set_has_proposal(msg.proposal)
-                    await self.cs.add_proposal(msg.proposal, env.from_)
+                    ctx = self._start_trace(env)
+                    await self.cs.add_proposal(msg.proposal, env.from_, trace_ctx=ctx)
+                    self._finish_receive(ctx, env, "data")
                 elif isinstance(msg, m.ProposalPOLMessage):
                     if ps is not None:
                         ps.apply_proposal_pol(msg)
                 elif isinstance(msg, m.BlockPartMessage):
                     if ps is not None:
                         ps.set_has_proposal_block_part(msg.height, msg.round, msg.part.index)
-                    await self.cs.add_block_part(msg.height, msg.round, msg.part, env.from_)
+                    ctx = self._start_trace(env)
+                    await self.cs.add_block_part(
+                        msg.height, msg.round, msg.part, env.from_, trace_ctx=ctx
+                    )
+                    self._finish_receive(ctx, env, "data")
             except Exception as e:
                 await self.data_ch.error(PeerError(env.from_, f"data msg: {e!r}"))
 
@@ -229,7 +260,9 @@ class ConsensusReactor(Service):
             if ps is not None:
                 v = msg.vote
                 ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
-            await self.cs.add_vote(msg.vote, env.from_)
+            ctx = self._start_trace(env)
+            await self.cs.add_vote(msg.vote, env.from_, trace_ctx=ctx)
+            self._finish_receive(ctx, env, "vote")
 
     async def _process_bits_ch(self) -> None:
         async for env in self.bits_ch:

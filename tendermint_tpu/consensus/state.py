@@ -26,12 +26,15 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import weakref
 from dataclasses import dataclass
 from typing import Callable
 
 from ..config import ConsensusConfig
 from ..evidence import EvidencePoolI, NopEvidencePool
+from ..libs import trace
 from ..libs.clock import SYSTEM, Clock
+from ..libs.metrics import Histogram
 from ..libs.service import Service
 from ..privval import PrivValidator
 from ..state.execution import BlockExecutor
@@ -64,6 +67,10 @@ class MsgInfo:
     # proven in stage 1, don't re-check at apply; False = proven bad,
     # drop at apply; None = unknown, apply verifies synchronously
     sig_ok: bool | None = None
+    # flight-recorder context (libs/trace.TraceCtx) following this
+    # message end-to-end; None when tracing is off or the message is
+    # internally generated. NEVER serialized into the WAL.
+    trace: object = None
 
 
 # queue sentinel: mempool signalled txs-available (create_empty_blocks=false)
@@ -72,6 +79,59 @@ _TXS_AVAILABLE = object()
 
 class ConsensusError(RuntimeError):
     pass
+
+
+# -- step-latency metrics ---------------------------------------------------
+#
+# consensus_step_duration_seconds{step=} + consensus_time_to_commit_seconds:
+# round progress used to be invisible outside test asserts. Each running
+# ConsensusState keeps its own histograms (multi-node in-process tests run
+# several); NodeMetrics folds the registry at render time, mirroring
+# consensus/ingest.aggregate.
+
+#: step-duration buckets (seconds): fast_config rounds are tens of ms,
+#: production rounds seconds
+STEP_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+#: metric label per RoundStep — the wait variants fold into their step
+#: (PREVOTE_WAIT is still time spent deciding the prevote outcome)
+STEP_LABELS = ("new_height", "new_round", "propose", "prevote", "precommit", "commit")
+
+_STEP_LABEL = {
+    RoundStep.NEW_HEIGHT: "new_height",
+    RoundStep.NEW_ROUND: "new_round",
+    RoundStep.PROPOSE: "propose",
+    RoundStep.PREVOTE: "prevote",
+    RoundStep.PREVOTE_WAIT: "prevote",
+    RoundStep.PRECOMMIT: "precommit",
+    RoundStep.PRECOMMIT_WAIT: "precommit",
+    RoundStep.COMMIT: "commit",
+}
+
+_step_states: "weakref.WeakSet[ConsensusState]" = weakref.WeakSet()
+
+
+def aggregate_step_metrics():
+    """({step label: (counts, sum, count)}, time-to-commit fold) across
+    every running ConsensusState, or (None, None) when none is up."""
+    states = [s for s in _step_states]
+    if not states:
+        return None, None
+
+    def fold(hists):
+        counts = [0] * (len(STEP_BUCKETS) + 1)
+        total_sum, total_count = 0.0, 0
+        for h in hists:
+            for i, c in enumerate(h._counts):
+                counts[i] += c
+            total_sum += h._sum
+            total_count += h._count
+        return counts, total_sum, total_count
+
+    per_step = {
+        label: fold([s.step_hist[label] for s in states]) for label in STEP_LABELS
+    }
+    return per_step, fold([s.ttc_hist for s in states])
 
 
 class ConsensusState(Service):
@@ -153,6 +213,25 @@ class ConsensusState(Service):
         self._decided: asyncio.Event = asyncio.Event()
         self._sign_jobs: list[tuple] = []  # deferred privval signing
 
+        # step-latency instrumentation (folded into /metrics via
+        # aggregate_step_metrics; durations on the injected clock's
+        # monotonic domain so chaos runs stay deterministic)
+        self.step_hist = {
+            label: Histogram(
+                f"consensus_step_duration_seconds_{label}",
+                "time spent in this consensus step",
+                buckets=STEP_BUCKETS,
+            )
+            for label in STEP_LABELS
+        }
+        self.ttc_hist = Histogram(
+            "consensus_time_to_commit_seconds",
+            "height start to committed block",
+            buckets=STEP_BUCKETS,
+        )
+        self._step_entered: tuple | None = None  # (RoundStep, entered_at)
+        self._height_t0 = self.clock.monotonic()
+
         self.update_to_state(state)
 
     # ------------------------------------------------------------------
@@ -160,6 +239,7 @@ class ConsensusState(Service):
     # ------------------------------------------------------------------
 
     async def on_start(self) -> None:
+        _step_states.add(self)
         if self.wal is not None:
             self.catchup_replay()
         if self.ingest is not None:
@@ -178,6 +258,7 @@ class ConsensusState(Service):
         )
 
     async def on_stop(self) -> None:
+        _step_states.discard(self)  # stop folding into /metrics
         self.ticker.stop()
         if self.ingest is not None:
             self.ingest.stop()
@@ -188,18 +269,25 @@ class ConsensusState(Service):
     # public input
     # ------------------------------------------------------------------
 
-    async def add_proposal(self, proposal: Proposal, peer_id: str = "") -> None:
-        await self._ingest_put(MsgInfo(m.ProposalMessage(proposal), peer_id))
-
-    async def add_block_part(
-        self, height: int, round_: int, part: Part, peer_id: str = ""
+    async def add_proposal(
+        self, proposal: Proposal, peer_id: str = "", trace_ctx=None
     ) -> None:
         await self._ingest_put(
-            MsgInfo(m.BlockPartMessage(height, round_, part), peer_id)
+            MsgInfo(m.ProposalMessage(proposal), peer_id, trace=trace_ctx)
         )
 
-    async def add_vote(self, vote: Vote, peer_id: str = "") -> None:
-        await self._ingest_put(MsgInfo(m.VoteMessage(vote), peer_id))
+    async def add_block_part(
+        self, height: int, round_: int, part: Part, peer_id: str = "",
+        trace_ctx=None,
+    ) -> None:
+        await self._ingest_put(
+            MsgInfo(m.BlockPartMessage(height, round_, part), peer_id, trace=trace_ctx)
+        )
+
+    async def add_vote(self, vote: Vote, peer_id: str = "", trace_ctx=None) -> None:
+        await self._ingest_put(
+            MsgInfo(m.VoteMessage(vote), peer_id, trace=trace_ctx)
+        )
 
     async def _ingest_put(self, mi: MsgInfo) -> None:
         """Peer inputs enter through the pipelined ingest when it is
@@ -267,9 +355,19 @@ class ConsensusState(Service):
         rs.last_validators = state.last_validators.copy() if state.last_validators else None
         rs.triggered_timeout_precommit = False
         self.state = state
+        self._height_t0 = self.clock.monotonic()  # time-to-commit anchor
         self._new_step()
 
     def _new_step(self) -> None:
+        # step-duration accounting: observe the step being LEFT (wait
+        # variants fold into their parent step's label)
+        now = self.clock.monotonic()
+        prev = self._step_entered
+        self._step_entered = (self.rs.step, now)
+        if prev is not None and prev[0] != self.rs.step and not self._replay_mode:
+            label = _STEP_LABEL.get(prev[0])
+            if label is not None:
+                self.step_hist[label].observe(max(0.0, now - prev[1]))
         if self.step_hook is not None:
             self.step_hook(self.rs)
         if self.event_bus is not None:
@@ -361,7 +459,27 @@ class ConsensusState(Service):
                     self._wal_write(
                         m.encode_wal_message(item.msg, item.peer_id), sync=False
                     )
-                    self._handle_msg(item)
+                    ctx = item.trace
+                    if ctx is None:
+                        self._handle_msg(item)
+                    else:
+                        # apply span starts at the reorder release so the
+                        # four ingest stages tile the end-to-end span:
+                        # wait + verify + reorder + apply == msg, exactly
+                        t_apply = ctx.marks.get("release", self.clock.monotonic())
+                        try:
+                            self._handle_msg(item)
+                        finally:
+                            t_done = self.clock.monotonic()
+                            kind = type(item.msg).__name__
+                            trace.record(
+                                ctx, "consensus", "apply", t_apply, t_done, msg=kind
+                            )
+                            trace.record(
+                                ctx, "consensus", "msg",
+                                ctx.marks.get("submit", ctx.t0), t_done,
+                                msg=kind, peer=item.peer_id, sig_ok=item.sig_ok,
+                            )
             except ConflictingVoteError as e:
                 self.evidence_pool.report_conflicting_votes(e.existing, e.new)
                 self.logger.info(
@@ -984,6 +1102,14 @@ class ConsensusState(Service):
         fail.fail_point(3)  # marker written, before ApplyBlock
 
         state, _ = await self.block_exec.apply_block(self.state, block_id, block)
+
+        if not self._replay_mode:
+            ttc = max(0.0, self.clock.monotonic() - self._height_t0)
+            self.ttc_hist.observe(ttc)
+            trace.emit(
+                "consensus", "height", duration_s=ttc, clock=self.clock,
+                height=height, round=rs.commit_round,
+            )
 
         # next height
         rs.commit_time_ns = self.clock.now_ns()

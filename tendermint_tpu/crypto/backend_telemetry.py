@@ -1,0 +1,141 @@
+"""Backend-attach telemetry — the accelerator's black box recorder.
+
+BENCH_r01–r05 lost the TPU in four of five rounds: backend init hung
+past 180 s, the run re-exec'd onto a ~50–174 sigs/s JAX-CPU fallback,
+and the only artifact was a stderr tail. This module makes every
+attach-path event a first-class signal: attach attempts (latency +
+outcome), XLA compile/warmup durations per shape bucket, TPU→CPU
+fallback transitions, and circuit-breaker state changes all land
+
+  * in the module-level stores below (folded into `/metrics` at render
+    time by `libs/metrics.NodeMetrics`, exactly like RESILIENCE and
+    STORAGE — crypto backends are process-wide, not per-node), and
+  * in the flight recorder (`libs/trace.py`) as ``backend.*`` spans, so
+    a trace dump shows WHEN the device came up relative to the traffic
+    that needed it.
+
+Metric families rendered from here: ``backend_attach_attempts``,
+``backend_attach_latency_seconds`` (histogram),
+``backend_compile_seconds{shape=}``, ``backend_active{kind=}``,
+``backend_fallbacks``, ``backend_breaker_transitions``.
+
+Writers: `crypto/batch.py` (probe — attach runs behind
+`libs/watchdog.BackendInitWatchdog` — warmup, breaker, fallback),
+`bench.py` (its re-exec-based init emits the same record shape into the
+BENCH JSON).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..libs import trace
+
+logger = logging.getLogger("crypto.backend_telemetry")
+
+#: attach-latency buckets (seconds): init ranges from sub-second (warm
+#: CPU) through the multi-minute tunnel cliffs the bench rounds hit
+ATTACH_BUCKETS = (0.1, 0.5, 1, 5, 10, 30, 60, 120, 180, 300)
+
+#: counters folded into /metrics at render time
+BACKEND: dict[str, float] = {
+    "attach_attempts": 0.0,   # init attempts (success or not)
+    "attach_failures": 0.0,   # attempts that raised or timed out
+    "fallbacks": 0.0,         # TPU->CPU fallback EVENTS (per failed batch)
+    "breaker_transitions": 0.0,  # breaker open/half-open/close events
+}
+
+#: per-attempt latency observations (seconds) — rendered as the
+#: backend_attach_latency_seconds histogram; bounded so a flapping
+#: tunnel cannot grow it without limit
+ATTACH_LATENCIES: list[float] = []
+_MAX_LATENCIES = 512
+
+#: shape bucket -> last compile/warmup duration (seconds)
+COMPILE_SECONDS: dict[str, float] = {}
+
+#: which verifier the process is actually using right now
+ACTIVE: dict[str, str] = {"kind": "none"}  # "tpu" | "cpu" | "none"
+
+
+def record_attach_attempt(
+    latency_s: float, ok: bool, *, kind: str = "", error: str = ""
+) -> None:
+    """One backend-init attempt finished (or timed out). `kind` is the
+    platform that came up ("tpu"/"cpu"/the jax platform name)."""
+    BACKEND["attach_attempts"] += 1
+    if not ok:
+        BACKEND["attach_failures"] += 1
+    if len(ATTACH_LATENCIES) < _MAX_LATENCIES:
+        ATTACH_LATENCIES.append(latency_s)
+    trace.emit(
+        "backend",
+        "attach",
+        duration_s=latency_s,
+        ok=ok,
+        kind=kind or "unknown",
+        **({"error": error} if error else {}),
+    )
+    if ok and kind:
+        set_active(kind)
+    logger.info(
+        "backend attach attempt: %s in %.2fs%s",
+        "up" if ok else "FAILED",
+        latency_s,
+        f" ({kind})" if kind else (f" ({error})" if error else ""),
+    )
+
+
+def record_compile(shape: str, seconds: float) -> None:
+    """An XLA compile/warmup finished for one shape bucket (the floor
+    chunk, the blocksync max bucket, the fallback kernel, …)."""
+    COMPILE_SECONDS[shape] = seconds
+    trace.emit("backend", "compile", duration_s=seconds, shape=shape)
+
+
+def record_fallback(from_kind: str, to_kind: str, reason: str) -> None:
+    """The routing moved off the preferred backend (breaker trip,
+    failed batch, init giving up). Dumps the flight ring — but only on
+    an actual active-kind TRANSITION: a flapping device with the breaker
+    half-open re-probes repeatedly, and every failed probe lands here;
+    one dump per transition bounds the file stream and keeps the hub
+    worker thread off the disk (mirrors LoopWatchdog's one-report-per-
+    wedge discipline)."""
+    BACKEND["fallbacks"] += 1
+    transitioned = ACTIVE["kind"] != to_kind
+    set_active(to_kind)
+    trace.emit("backend", "fallback", from_kind=from_kind, to_kind=to_kind, reason=reason)
+    logger.warning("backend fallback %s -> %s: %s", from_kind, to_kind, reason)
+    if transitioned:
+        trace.auto_dump("backend-fallback")
+
+
+def record_breaker(state: str) -> None:
+    """TPU circuit-breaker state change ("open"/"half-open"/"closed")."""
+    BACKEND["breaker_transitions"] += 1
+    trace.emit("backend", "breaker", state=state)
+
+
+def set_active(kind: str) -> None:
+    ACTIVE["kind"] = kind
+
+
+def snapshot() -> dict:
+    """JSON-ready view (bench output, /debug endpoints)."""
+    lat = sorted(ATTACH_LATENCIES)
+    return {
+        **{k: v for k, v in BACKEND.items()},
+        "attach_latency_s": [round(v, 3) for v in ATTACH_LATENCIES],
+        "attach_latency_max_s": round(lat[-1], 3) if lat else 0.0,
+        "compile_seconds": {k: round(v, 3) for k, v in COMPILE_SECONDS.items()},
+        "active_kind": ACTIVE["kind"],
+    }
+
+
+def reset() -> None:
+    """Test hook: clear all process-wide stores."""
+    for k in BACKEND:
+        BACKEND[k] = 0.0
+    ATTACH_LATENCIES.clear()
+    COMPILE_SECONDS.clear()
+    ACTIVE["kind"] = "none"

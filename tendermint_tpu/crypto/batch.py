@@ -80,25 +80,63 @@ _tpu_probe_started = False
 def _probe_tpu() -> None:
     """Background probe: bring the JAX backend up, warm the kernel, and
     MEASURE the CPU/TPU crossover batch size so routing is based on this
-    host's actual rates, not a guess."""
+    host's actual rates, not a guess. Every phase is recorded into
+    `backend_telemetry` (attach latency, per-shape compile durations,
+    the active verifier kind) so the attach story is readable from
+    /metrics and trace dumps instead of log tails."""
+    import time as _time
+
     global _tpu_available
+    from . import backend_telemetry as bt
+
+    attach_recorded = False
     try:
+        from ..libs.watchdog import BackendInitWatchdog
         from .tpu.verify import backend_ready, warmup
 
-        ok = backend_ready()
+        # watchdogged attach (ROADMAP: no more one 180 s cliff): bounded
+        # short attempts with a cheap poll that adopts an earlier hung
+        # attempt finishing late (jax init holds a global lock, so the
+        # thread can't be killed — only outwaited). Each attempt lands
+        # in backend_telemetry; a hung tunnel now costs bounded time
+        # before the CPU path takes over instead of wedging the probe.
+        wd = BackendInitWatchdog(
+            attempts=int(os.environ.get("TMTPU_ATTACH_ATTEMPTS", "3")),
+            timeout_s=float(os.environ.get("TMTPU_ATTACH_TIMEOUT", "60")),
+            name="tpu-attach",
+        )
+        ok = bool(wd.run(backend_ready))
+        attach_recorded = True
+        kind = ""
+        if ok:
+            # the JAX backend that actually answered: "tpu" only when a
+            # device platform is behind it (a CPU-pinned image routes the
+            # same kernels through the JAX-CPU backend)
+            try:
+                import jax
+
+                platform = jax.devices()[0].platform
+                kind = "tpu" if platform not in ("cpu",) else "cpu"
+            except Exception:  # noqa: BLE001 — kind is diagnostics only
+                kind = "unknown"
+            bt.set_active(kind)
         if ok:
             # fallback=True also compiles the per-signature attribution
             # kernel: the first bad signature in a gossiped batch must not
             # stall verification behind an inline JIT compile. groups=150
             # warms the grouped A-side at the bucket a realistic validator
             # set lands on (gb=255), not just the all-padding floor shape
+            t0 = _time.monotonic()
             warmup(groups=150, fallback=True)
+            bt.record_compile("floor", _time.monotonic() - t0)
             _measure_cutoff()
         # the TPU is usable as soon as the floor shapes are warm — flip
         # availability BEFORE the optional big-bucket warm below, so
         # normal consensus batches aren't CPU-routed for the minutes a
         # cold 8192-shape compile can take
         _tpu_available = ok
+        if not ok:
+            bt.set_active("cpu")
         logger.info("TPU batch verifier %s", "ready" if ok else "unavailable")
         if ok:
             # pre-compile the block-sync range shape too (still on the
@@ -110,11 +148,19 @@ def _probe_tpu() -> None:
             from .tpu.verify import _MAX_BUCKET
 
             try:
+                t0 = _time.monotonic()
                 warmup(bucket=_MAX_BUCKET, groups=150, fallback=True)
+                bt.record_compile("max", _time.monotonic() - t0)
             except Exception as e:  # noqa: BLE001
                 logger.info("big-bucket warmup failed (non-fatal): %r", e)
     except Exception as e:
         logger.info("TPU batch verifier unavailable: %r", e)
+        if not attach_recorded:
+            # import/infra failure before the watchdog ran; a warmup or
+            # cutoff-measure failure AFTER a successful attach must not
+            # double-count the attempt
+            bt.record_attach_attempt(0.0, False, error=repr(e))
+        bt.set_active("cpu")
         _tpu_available = False
 
 
@@ -196,6 +242,12 @@ def tpu_verifier_available(*, blocking: bool = False) -> bool:
 # TMTPU_MIN_TPU_BATCH pins it explicitly.
 MIN_TPU_BATCH = int(os.environ.get("TMTPU_MIN_TPU_BATCH", "32"))
 
+#: where the most recent adaptive batch actually executed ("tpu",
+#: "cpu", or "cpu-fallback" after a device error). Diagnostics only —
+#: the VerifyHub stamps it on dispatch spans so a trace dump shows
+#: which backend served each batch.
+LAST_ROUTE = "cpu"
+
 
 # TPU-path circuit breaker: any backend/kernel error mid-batch trips it
 # (the batch transparently re-verifies on the CPU — results are identical,
@@ -230,6 +282,10 @@ class AdaptiveBatchVerifier(BatchVerifier):
 
     def __init__(self):
         self._items: list[tuple[PubKey, bytes, bytes]] = []
+        #: where the last verify() ran ("tpu"/"cpu"/"cpu-fallback") —
+        #: per-instance, unlike the process-global LAST_ROUTE, so
+        #: concurrent verifiers can't misattribute each other's batches
+        self.last_route = "cpu"
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key.TYPE not in _BATCHABLE:
@@ -240,11 +296,16 @@ class AdaptiveBatchVerifier(BatchVerifier):
         self._items.append((pub_key, msg, sig))
 
     def verify(self) -> tuple[bool, list[bool]]:
+        global LAST_ROUTE
+        route = "cpu"
         if len(self._items) >= MIN_TPU_BATCH and tpu_verifier_available():
             probing = _tpu_breaker.state != "closed"  # read before allow() claims
             if _tpu_breaker.allow():
+                from . import backend_telemetry as bt
+
                 if probing:
                     record_resilience("tpu_breaker_probes")
+                    bt.record_breaker("half-open")
                     logger.info("TPU breaker half-open: probing the device path")
                 try:
                     out = self._run(self._make_tpu_verifier())
@@ -255,6 +316,9 @@ class AdaptiveBatchVerifier(BatchVerifier):
                     record_resilience("tpu_fallback_sigs", len(self._items))
                     if _tpu_breaker.opens > opens_before:
                         record_resilience("tpu_breaker_opens")
+                        bt.record_breaker("open")
+                    bt.record_fallback("tpu", "cpu", repr(e))
+                    route = "cpu-fallback"
                     logger.warning(
                         "TPU batch verification failed (%r); re-verifying "
                         "%d signatures on CPU (breaker %s)",
@@ -263,8 +327,13 @@ class AdaptiveBatchVerifier(BatchVerifier):
                         _tpu_breaker.state,
                     )
                 else:
+                    if probing:
+                        bt.record_breaker("closed")
+                        bt.set_active("tpu")
                     _tpu_breaker.record_success()
+                    LAST_ROUTE = self.last_route = "tpu"
                     return out
+        LAST_ROUTE = self.last_route = route
         return self._run(CPUBatchVerifier())
 
     def _make_tpu_verifier(self) -> BatchVerifier:

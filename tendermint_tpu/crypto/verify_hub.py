@@ -60,6 +60,7 @@ import time
 from collections import OrderedDict
 from concurrent.futures import Future, ThreadPoolExecutor
 
+from ..libs import trace
 from ..libs.metrics import Histogram
 from . import PubKey
 from .batch import create_batch_verifier, supports_batch_verifier
@@ -82,11 +83,15 @@ LATENCY_BUCKETS = (
 
 class _Pending:
     """One unique (pubkey, msg, sig) triple awaiting a verdict. Duplicate
-    submissions while it is queued/in flight append their futures here."""
+    submissions while it is queued/in flight append their futures here
+    (and their trace contexts — a coalesced gossip duplicate still gets
+    hub.queue/hub.execute spans on its own trace)."""
 
-    __slots__ = ("key", "pub_key", "msg", "sig", "futures", "enqueued_at", "lane")
+    __slots__ = (
+        "key", "pub_key", "msg", "sig", "futures", "enqueued_at", "lane", "traces",
+    )
 
-    def __init__(self, key, pub_key, msg, sig, fut, now, lane):
+    def __init__(self, key, pub_key, msg, sig, fut, now, lane, trace_ctx=None):
         self.key = key
         self.pub_key = pub_key
         self.msg = msg
@@ -94,6 +99,19 @@ class _Pending:
         self.futures: list[Future] = [fut]
         self.enqueued_at = now
         self.lane = lane
+        # (ctx, joined_at): a coalesced duplicate's queue wait starts
+        # when IT joined, not when the first submitter enqueued — else
+        # its queue span would begin before its own trace did
+        self.traces: list | None = [(trace_ctx, now)] if trace_ctx is not None else None
+
+    def add_trace(self, trace_ctx) -> None:
+        if trace_ctx is None:
+            return
+        entry = (trace_ctx, time.monotonic())
+        if self.traces is None:
+            self.traces = [entry]
+        else:
+            self.traces.append(entry)
 
 
 def _cache_key(pub_key: PubKey, msg: bytes, sig: bytes) -> tuple:
@@ -158,6 +176,8 @@ class VerifyHub:
         self._runner: ThreadPoolExecutor | None = None
         self._slots = threading.BoundedSemaphore(self.MAX_INFLIGHT_BATCHES)
         self._worker_ids: set[int] = set()
+        # per-worker-thread route of the batch just verified (trace attrs)
+        self._route_local = threading.local()
         # occupancy EWMA seeds at max_batch: start optimistic (full
         # window) and adapt DOWN — the first dispatches under light load
         # pay at most one window, never a stuck-small window under load
@@ -229,6 +249,7 @@ class VerifyHub:
         *,
         urgent: bool = False,
         lane: str = LANE_LIVE,
+        trace_ctx=None,
     ) -> Future:
         """Enqueue one verification; returns a concurrent Future[bool].
 
@@ -236,7 +257,9 @@ class VerifyHub:
         every request queued at dispatch time — urgency costs
         coalescing-with-the-future, not coalescing-with-the-present).
         `lane` picks the scheduler lane: live consensus is packed ahead
-        of backfill in every dispatch."""
+        of backfill in every dispatch. `trace_ctx` (libs/trace.TraceCtx)
+        joins the request to an end-to-end trace: the hub records
+        hub.queue and hub.execute spans on it."""
         if lane not in self._queues:
             # a typo'd lane at a new call site must fail loudly — a
             # silent fall-through to "live" would hand bulk catch-up
@@ -251,6 +274,13 @@ class VerifyHub:
             if verdict is not None:
                 self._cache.move_to_end(key)
                 self._stats["cache_hits"] += 1
+                if trace_ctx is not None:
+                    # zero-width marker anchored on the TRACE clock: the
+                    # trace may time on an injected chaos clock, and a
+                    # SYSTEM timestamp would land at a wrong offset in
+                    # the per-trace view when rates diverge
+                    now = trace_ctx.clock.monotonic()
+                    trace.record(trace_ctx, "hub", "cache_hit", now, now, lane=lane)
                 fut.set_result(verdict)
                 return fut
             pending = (
@@ -260,6 +290,7 @@ class VerifyHub:
             )
             if pending is not None:
                 pending.futures.append(fut)
+                pending.add_trace(trace_ctx)
                 self._stats["coalesced"] += 1
                 if (
                     lane == LANE_LIVE
@@ -285,7 +316,8 @@ class VerifyHub:
             else:
                 q = self._queues[lane]
                 q[key] = _Pending(
-                    key, pub_key, msg, sig, fut, time.monotonic(), lane
+                    key, pub_key, msg, sig, fut, time.monotonic(), lane,
+                    trace_ctx=trace_ctx,
                 )
                 self._stats["submitted"] += 1
                 self._stats[f"lane_{lane}_submitted"] += 1
@@ -320,12 +352,18 @@ class VerifyHub:
         )
 
     async def verify(
-        self, pub_key: PubKey, msg: bytes, sig: bytes, *, lane: str = LANE_LIVE
+        self,
+        pub_key: PubKey,
+        msg: bytes,
+        sig: bytes,
+        *,
+        lane: str = LANE_LIVE,
+        trace_ctx=None,
     ) -> bool:
         """Async API: awaits the batched verdict without blocking the
         event loop; concurrent awaiters coalesce into one dispatch."""
         return await asyncio.wrap_future(
-            self.submit_nowait(pub_key, msg, sig, lane=lane)
+            self.submit_nowait(pub_key, msg, sig, lane=lane, trace_ctx=trace_ctx)
         )
 
     def verify_many(
@@ -444,6 +482,20 @@ class VerifyHub:
                 now = time.monotonic()
                 for p in batch:
                     self.latency_hist.observe(now - p.enqueued_at)
+                    if p.traces:
+                        # queue span: submit-to-pack wait, per joined
+                        # trace. enqueued_at is SYSTEM-domain; the trace
+                        # may time on an injected chaos clock, so measure
+                        # the wait in SYSTEM and anchor it ending at the
+                        # trace clock's now (the reactor does the same
+                        # for p2p.receive)
+                        for ctx, joined in p.traces:
+                            tc_now = ctx.clock.monotonic()
+                            trace.record(
+                                ctx, "hub", "queue",
+                                tc_now - max(0.0, now - joined), tc_now,
+                                lane=p.lane,
+                            )
                 self._stats["dispatches"] += 1
                 self._stats["dispatched_sigs"] += len(batch)
                 alpha = 0.2
@@ -470,6 +522,7 @@ class VerifyHub:
 
     def _run_batch(self, batch: list[_Pending]) -> None:
         self._worker_ids.add(threading.get_ident())
+        t0 = time.monotonic()
         try:
             results = self._verify_batch(batch)
         except Exception as e:  # noqa: BLE001 — fail the batch, not the hub
@@ -492,6 +545,30 @@ class VerifyHub:
                     self._cache.move_to_end(p.key)
                     while len(self._cache) > self.cache_size:
                         self._cache.popitem(last=False)
+        if trace.is_enabled():
+            # per-batch dispatch span + per-trace execute spans, stamped
+            # with where THIS batch actually ran. _verify_batch stashed
+            # the route in a thread-local: the process-global
+            # batch.LAST_ROUTE can be overwritten by concurrent
+            # verifiers elsewhere (the validation funnel builds its own)
+            route = getattr(self._route_local, "route", "cpu")
+            t1 = time.monotonic()
+            trace.emit(
+                "hub", "dispatch",
+                duration_s=t1 - t0, sigs=len(batch), route=route,
+            )
+            for p in batch:
+                if p.traces:
+                    for ctx, _ in p.traces:
+                        # t0/t1 are SYSTEM-domain; anchor the execute
+                        # span ending at the trace clock's now so it
+                        # sits correctly among the trace's other spans
+                        # under an injected chaos clock
+                        tc_now = ctx.clock.monotonic()
+                        trace.record(
+                            ctx, "hub", "execute", tc_now - (t1 - t0), tc_now,
+                            batch=len(batch), route=route,
+                        )
         for p, ok in zip(batch, results):
             for f in p.futures:
                 if not f.done():
@@ -509,6 +586,10 @@ class VerifyHub:
                 batchable.append(i)
             else:
                 results[i] = p.pub_key.verify_signature(p.msg, p.sig)
+        # where this batch ran, for the dispatch/execute spans: set per
+        # worker thread (concurrent _run_batch calls must not race), and
+        # "cpu" on the host-side paths where no AdaptiveBatchVerifier runs
+        self._route_local.route = "cpu"
         if len(batchable) == 1:
             p = batch[batchable[0]]
             results[batchable[0]] = p.pub_key.verify_signature(p.msg, p.sig)
@@ -518,6 +599,7 @@ class VerifyHub:
                 p = batch[i]
                 bv.add(p.pub_key, p.msg, p.sig)
             _ok, bitmap = bv.verify()
+            self._route_local.route = getattr(bv, "last_route", "cpu")
             for i, good in zip(batchable, bitmap):
                 results[i] = bool(good)
         return results

@@ -53,17 +53,20 @@ class Gauge:
 
 
 class Histogram:
-    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count")
+    __slots__ = ("name", "help", "buckets", "_counts", "_sum", "_count", "const_labels")
 
     DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
 
-    def __init__(self, name: str, help_: str = "", buckets=None):
+    def __init__(self, name: str, help_: str = "", buckets=None, const_labels=()):
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._count = 0
+        # constant labels stamped on every series (a HistogramFamily
+        # child carries e.g. ("step", "propose"))
+        self.const_labels = tuple(const_labels)
 
     def observe(self, value: float) -> None:
         self._sum += value
@@ -74,16 +77,56 @@ class Histogram:
                 return
         self._counts[-1] += 1
 
-    def render(self) -> list[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def _series(self) -> list[str]:
+        base = self.const_labels
+        out = []
         cum = 0
         for i, b in enumerate(self.buckets):
             cum += self._counts[i]
-            out.append(f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}')
+            out.append(
+                f"{self.name}_bucket{_fmt_labels(base + (('le', _fmt(b)),))} {cum}"
+            )
         cum += self._counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {_fmt(self._sum)}")
-        out.append(f"{self.name}_count {self._count}")
+        out.append(f"{self.name}_bucket{_fmt_labels(base + (('le', '+Inf'),))} {cum}")
+        out.append(f"{self.name}_sum{_fmt_labels(base)} {_fmt(self._sum)}")
+        out.append(f"{self.name}_count{_fmt_labels(base)} {self._count}")
+        return out
+
+    def render(self) -> list[str]:
+        return [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+            *self._series(),
+        ]
+
+
+class HistogramFamily:
+    """One histogram name split by a single label (e.g.
+    consensus_step_duration_seconds{step=}): children share buckets and
+    render under one HELP/TYPE header."""
+
+    __slots__ = ("name", "help", "label", "buckets", "_hists")
+
+    def __init__(self, name: str, label: str, help_: str = "", buckets=None):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self.buckets = tuple(buckets or Histogram.DEFAULT_BUCKETS)
+        self._hists: dict[str, Histogram] = {}
+
+    def labeled(self, value: str) -> Histogram:
+        h = self._hists.get(value)
+        if h is None:
+            h = self._hists[value] = Histogram(
+                self.name, self.help, self.buckets,
+                const_labels=((self.label, value),),
+            )
+        return h
+
+    def render(self) -> list[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for value in sorted(self._hists):
+            out.extend(self._hists[value]._series())
         return out
 
 
@@ -150,6 +193,15 @@ class Registry:
 
     def histogram(self, subsystem: str, name: str, help_: str = "", buckets=None) -> Histogram:
         m = Histogram(f"{self.namespace}_{subsystem}_{name}", help_, buckets)
+        self._metrics.append(m)
+        return m
+
+    def histogram_family(
+        self, subsystem: str, name: str, label: str, help_: str = "", buckets=None
+    ) -> HistogramFamily:
+        m = HistogramFamily(
+            f"{self.namespace}_{subsystem}_{name}", label, help_, buckets
+        )
         self._metrics.append(m)
         return m
 
@@ -304,6 +356,55 @@ class NodeMetrics:
             "verdict-to-in-order-release wait per message",
             buckets=LATENCY_BUCKETS,
         )
+        # consensus step latency (consensus/state.py per-CS histograms
+        # registered process-wide, folded in at render time)
+        from ..consensus.state import STEP_BUCKETS, STEP_LABELS
+
+        self.consensus_step_duration = r.histogram_family(
+            "consensus",
+            "step_duration_seconds",
+            "step",
+            "time spent per consensus step (propose/prevote/precommit/commit)",
+            buckets=STEP_BUCKETS,
+        )
+        for label in STEP_LABELS:  # every step series present from scrape 1
+            self.consensus_step_duration.labeled(label)
+        self.consensus_time_to_commit = r.histogram(
+            "consensus",
+            "time_to_commit_seconds",
+            "height start to committed block",
+            buckets=STEP_BUCKETS,
+        )
+        # backend attach telemetry (crypto/backend_telemetry.py —
+        # process-wide like the crypto backends themselves)
+        from ..crypto.backend_telemetry import ATTACH_BUCKETS
+
+        self.backend_attach_attempts = r.counter(
+            "backend", "attach_attempts", "accelerator backend init attempts"
+        )
+        self.backend_attach_failures = r.counter(
+            "backend", "attach_failures", "init attempts that raised or hung"
+        )
+        self.backend_fallbacks = r.counter(
+            "backend", "fallbacks",
+            "TPU->CPU fallback events (every failed device batch; "
+            "active-kind transitions gate the flight dump, not this count)"
+        )
+        self.backend_breaker_transitions = r.counter(
+            "backend", "breaker_transitions", "TPU breaker state changes"
+        )
+        self.backend_attach_latency = r.histogram(
+            "backend",
+            "attach_latency_seconds",
+            "per-attempt backend init latency",
+            buckets=ATTACH_BUCKETS,
+        )
+        self.backend_compile = r.gauge(
+            "backend", "compile_seconds", "last XLA compile/warmup time per shape"
+        )
+        self.backend_active = r.gauge(
+            "backend", "active", "1 for the verifier kind currently routing batches"
+        )
         # abci
         self.abci_latency = r.histogram(
             "abci", "connection_latency_seconds", "app call latency"
@@ -362,6 +463,48 @@ class NodeMetrics:
                 dst._sum = sum_
                 dst._count = count
 
+    def _fold_steps(self) -> None:
+        from ..consensus.state import aggregate_step_metrics
+
+        per_step, ttc = aggregate_step_metrics()
+        if per_step is None:
+            return
+        for label, (counts, sum_, count) in per_step.items():
+            dst = self.consensus_step_duration.labeled(label)
+            if len(counts) == len(dst._counts):
+                dst._counts = counts
+                dst._sum = sum_
+                dst._count = count
+        counts, sum_, count = ttc
+        dst = self.consensus_time_to_commit
+        if len(counts) == len(dst._counts):
+            dst._counts = counts
+            dst._sum = sum_
+            dst._count = count
+
+    def _fold_backend(self) -> None:
+        from ..crypto import backend_telemetry as bt
+
+        self.backend_attach_attempts._values[()] = bt.BACKEND["attach_attempts"]
+        self.backend_attach_failures._values[()] = bt.BACKEND["attach_failures"]
+        self.backend_fallbacks._values[()] = bt.BACKEND["fallbacks"]
+        self.backend_breaker_transitions._values[()] = bt.BACKEND[
+            "breaker_transitions"
+        ]
+        # rebuild the attach-latency histogram from the bounded
+        # observation list (attach events are rare; ≤512 entries)
+        dst = self.backend_attach_latency
+        dst._counts = [0] * (len(dst.buckets) + 1)
+        dst._sum = 0.0
+        dst._count = 0
+        for v in bt.ATTACH_LATENCIES:
+            dst.observe(v)
+        for shape, seconds in bt.COMPILE_SECONDS.items():
+            self.backend_compile.set(round(seconds, 4), shape=shape)
+        active = bt.ACTIVE["kind"]
+        for kind in ("tpu", "cpu", "none"):
+            self.backend_active.set(1.0 if kind == active else 0.0, kind=kind)
+
     def render(self) -> str:
         # fold the process-wide resilience events in at scrape time
         self.crypto_tpu_fallbacks._values[()] = RESILIENCE["tpu_fallback_batches"]
@@ -373,6 +516,8 @@ class NodeMetrics:
         self.wal_truncated_bytes._values[()] = STORAGE["wal_truncated_bytes"]
         self._fold_verify_hub()
         self._fold_ingest()
+        self._fold_steps()
+        self._fold_backend()
         return self.registry.render()
 
 

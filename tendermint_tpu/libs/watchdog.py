@@ -15,7 +15,18 @@ the loop being stuck) that schedules a trivial heartbeat callback via
 `threshold_s`, it writes every thread's Python stack and every asyncio
 task's stack to `<dir>/wedged-<ts>.txt` and logs loudly. One report per
 wedge (re-armed once the loop breathes again) — a wedged loop that
-recovers produces exactly one bundle, not a spray.
+recovers produces exactly one bundle, not a spray. A wedge also dumps
+the flight recorder (`libs/trace.auto_dump`): the spans leading up to
+the stall are the other half of the diagnosis.
+
+BackendInitWatchdog is the other watchdog this module grew for the
+ROADMAP attach problem: accelerator backend init (jax.devices() through
+a TPU tunnel) historically got ONE 180 s cliff — it either came up or
+the whole round fell to the CPU path with nothing recorded. The
+watchdog replaces the cliff with bounded short attempts plus a cheap
+periodic probe of earlier (still running) attempts, and records every
+attempt into `crypto/backend_telemetry` so attach behavior is visible
+in /metrics and the BENCH JSON.
 """
 
 from __future__ import annotations
@@ -86,6 +97,12 @@ class LoopWatchdog:
             if not responded and not wedged:
                 wedged = True
                 self._report()
+                try:
+                    from . import trace
+
+                    trace.auto_dump("loop-wedged")
+                except Exception as e:  # noqa: BLE001 — diagnostics only
+                    logger.debug("flight dump on wedge failed: %r", e)
             elif responded:
                 wedged = False
             self._stop.wait(self.interval_s)
@@ -129,3 +146,133 @@ class LoopWatchdog:
         logger.error(
             "event loop wedged >%ss; stacks dumped to %s", self.threshold_s, path
         )
+
+
+class BackendInitWatchdog:
+    """Bounded-retry, watchdogged backend init (ROADMAP: "a backend-init
+    watchdog that probes cheaply and retries instead of one 180 s
+    cliff").
+
+    `run(fn)` executes `fn` on a daemon thread with a per-attempt
+    timeout. A hung attempt is NOT a verdict: Python cannot kill the
+    thread (jax backend init holds a global lock), so the thread keeps
+    running and every later poll cheaply re-checks whether it finished
+    late — a tunnel that comes up at t=70 s is adopted by the attempt
+    that timed out at t=60 s, instead of being thrown away. Each
+    attempt (latency, outcome, error) is recorded into
+    `crypto/backend_telemetry` (-> /metrics + flight-recorder spans)
+    and kept in `self.log` for callers that serialize the story.
+    `crypto/batch._probe_tpu` runs the node-side attach behind this;
+    bench.py keeps its own re-exec-based init (a hung jax init holds a
+    global lock only a fresh process truly escapes) but emits the same
+    record shape into the BENCH JSON.
+    """
+
+    def __init__(
+        self,
+        *,
+        attempts: int = 3,
+        timeout_s: float = 60.0,
+        backoff_s: float = 5.0,
+        poll_s: float = 1.0,
+        name: str = "backend-init",
+    ):
+        self.attempts = max(1, attempts)
+        self.timeout_s = timeout_s
+        self.backoff_s = backoff_s
+        self.poll_s = max(0.05, poll_s)
+        self.name = name
+        #: structured per-attempt records: {latency_s, outcome, error?}
+        self.log: list[dict] = []
+
+    def _spawn(self, fn) -> dict:
+        slot: dict = {"t0": time.monotonic()}
+
+        def runner():
+            try:
+                slot["result"] = fn()
+            except Exception as e:  # noqa: BLE001 — reported per attempt
+                slot["error"] = e
+            slot["elapsed"] = time.monotonic() - slot["t0"]
+
+        t = threading.Thread(target=runner, name=self.name, daemon=True)
+        slot["thread"] = t
+        t.start()
+        return slot
+
+    @staticmethod
+    def _settled(slot: dict) -> bool:
+        return "result" in slot or "error" in slot
+
+    def run(self, fn):
+        """Returns `fn()`'s result when truthy, or None when every
+        bounded attempt raised, returned falsy, or hung (the caller
+        picks its fallback). Never raises."""
+        from ..crypto import backend_telemetry as bt
+
+        outstanding: list[dict] = []
+        for i in range(self.attempts):
+            slot = self._spawn(fn)
+            outstanding.append(slot)
+            deadline = time.monotonic() + self.timeout_s
+            while time.monotonic() < deadline:
+                # cheap probe: any attempt (this one OR an earlier hung
+                # one that finished late) settling ends the wait
+                for s in outstanding:
+                    if self._settled(s):
+                        break
+                else:
+                    slot["thread"].join(self.poll_s)
+                    continue
+                break
+            settled = next((s for s in outstanding if s.get("result")), None)
+            if settled is not None:
+                latency = settled.get("elapsed", time.monotonic() - settled["t0"])
+                self.log.append({"latency_s": round(latency, 3), "outcome": "ok"})
+                bt.record_attach_attempt(latency, True)
+                return settled["result"]
+            # a clean falsy return ("no backend here") is a FAILED
+            # attempt, not a success: telemetry must not count it as an
+            # attach, and the bounded retries still apply — a tunnel can
+            # answer "not yet" before it answers "ready"
+            unavailable = next((s for s in outstanding if "result" in s), None)
+            failed = next((s for s in outstanding if "error" in s), None)
+            if unavailable is not None:
+                outstanding.remove(unavailable)
+                latency = unavailable.get(
+                    "elapsed", time.monotonic() - unavailable["t0"]
+                )
+                self.log.append(
+                    {"latency_s": round(latency, 3), "outcome": "unavailable"}
+                )
+                bt.record_attach_attempt(latency, False, error="unavailable")
+                logger.warning(
+                    "%s attempt %d/%d: backend unavailable after %.1fs",
+                    self.name, i + 1, self.attempts, latency,
+                )
+            elif failed is not None:
+                outstanding.remove(failed)
+                latency = failed.get("elapsed", time.monotonic() - failed["t0"])
+                err = repr(failed["error"])
+                self.log.append(
+                    {"latency_s": round(latency, 3), "outcome": "error", "error": err}
+                )
+                bt.record_attach_attempt(latency, False, error=err)
+                logger.warning(
+                    "%s attempt %d/%d failed after %.1fs: %s",
+                    self.name, i + 1, self.attempts, latency, err,
+                )
+            else:
+                latency = time.monotonic() - slot["t0"]
+                self.log.append(
+                    {"latency_s": round(latency, 3), "outcome": "hung"}
+                )
+                bt.record_attach_attempt(latency, False, error="hung")
+                logger.warning(
+                    "%s attempt %d/%d hung past %.0fs (thread left running; "
+                    "later attempts keep probing it)",
+                    self.name, i + 1, self.attempts, self.timeout_s,
+                )
+            if i < self.attempts - 1 and self.backoff_s:
+                time.sleep(self.backoff_s * (i + 1))
+        return None

@@ -19,7 +19,7 @@ from .abci.application import Application
 from .blocksync import BLOCKSYNC_CHANNEL
 from .blocksync import messages as bs_msgs
 from .blocksync.reactor import BlockSyncReactor
-from .config import ConsensusConfig, MempoolConfig, VerifyHubConfig
+from .config import ConsensusConfig, MempoolConfig, TraceConfig, VerifyHubConfig
 from .consensus import messages as cs_msgs
 from .consensus.reactor import (
     DATA_CHANNEL,
@@ -117,6 +117,10 @@ class NodeConfig:
     # (consensus/ingest.py, ConsensusConfig.ingest_*) so many
     # verifications overlap per node.
     verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
+    # flight-recorder tracing (libs/trace.py): the process recorder is
+    # configured from the FIRST node's config (env mirrors win); spans
+    # are served at /debug/traces and auto-dumped on wedge/breaker trip
+    trace: TraceConfig = field(default_factory=TraceConfig)
 
 
 class Node(Service):
@@ -333,6 +337,13 @@ class Node(Service):
     async def on_start(self) -> None:
         import os
 
+        from .libs import trace as _trace
+
+        _trace.configure_once(
+            enabled=self.config.trace.enabled,
+            ring_size=self.config.trace.ring_size,
+            out_dir=self.config.trace.dump_dir,
+        )
         self.verify_hub = None
         hub_disabled = os.environ.get("TMTPU_VERIFYHUB_DISABLE", "").lower() not in (
             "", "0", "false",

@@ -19,6 +19,8 @@ import logging
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..libs import trace
+from ..libs.clock import SYSTEM
 from ..libs.service import Service
 from .peermanager import PeerManager
 from .transport import Connection, ConnectionClosedError, Transport
@@ -269,6 +271,10 @@ class Router(Service):
         try:
             while True:
                 ch_id, raw = await conn.receive_message()
+                # the flight recorder's "gossip byte" edge: stamped before
+                # decode so the receive span includes decode cost. Zero
+                # overhead when tracing is off.
+                recv_at = SYSTEM.monotonic() if trace.is_enabled() else 0.0
                 ch = self.channels.get(ch_id)
                 if ch is None:
                     continue  # unknown channel: ignore (peer may be newer)
@@ -277,7 +283,10 @@ class Router(Service):
                 except Exception as e:
                     await ch.error(PeerError(nid, f"malformed message: {e!r}"))
                     continue
-                env = Envelope(channel_id=ch_id, message=msg, raw=raw, from_=nid)
+                env = Envelope(
+                    channel_id=ch_id, message=msg, raw=raw, from_=nid,
+                    recv_at=recv_at,
+                )
                 try:
                     ch.in_q.put_nowait(env)
                 except asyncio.QueueFull:

@@ -107,6 +107,10 @@ class Envelope:
     from_: NodeID = ""
     to: NodeID = ""
     broadcast: bool = False
+    # monotonic stamp taken by the router as the bytes came off the
+    # wire (libs/trace flight recorder: the "gossip byte" edge of an
+    # end-to-end span). 0.0 when tracing is disabled.
+    recv_at: float = 0.0
 
 
 @dataclass(frozen=True)

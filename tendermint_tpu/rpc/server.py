@@ -52,6 +52,11 @@ class RPCServer:
         self.app.router.add_post("/", self._handle_jsonrpc)
         self.app.router.add_get("/websocket", self._handle_ws)
         self.app.router.add_get("/metrics", self._handle_metrics)
+        # flight-recorder endpoints (libs/trace.py): always on — reading
+        # the span ring is cheap and the whole layer is off-switchable
+        # via TMTPU_TRACE / [trace]
+        self.app.router.add_get("/debug/traces", self._handle_traces)
+        self.app.router.add_get("/debug/flight", self._handle_flight)
         if enable_pprof:
             # live profiling over HTTP — opt-in, like the reference which
             # only serves Go pprof when pprof-laddr is explicitly set
@@ -83,10 +88,53 @@ class RPCServer:
     async def _handle_metrics(self, request: web.Request) -> web.Response:
         metrics = getattr(self.env, "metrics", None)
         if metrics is None:
-            return web.Response(status=404, text="metrics disabled\n")
+            # an empty registry render, NOT a 404: scrapers and the e2e
+            # harness must not have to special-case node roles that
+            # carry no metrics object (seed nodes, light proxies)
+            from ..libs.metrics import Registry
+
+            return web.Response(
+                text=Registry().render(), content_type="text/plain", charset="utf-8"
+            )
         return web.Response(
             text=metrics.render(), content_type="text/plain", charset="utf-8"
         )
+
+    # -- flight recorder (libs/trace.py) ---------------------------------
+
+    async def _handle_traces(self, request: web.Request) -> web.Response:
+        """Last N spans from the flight recorder as JSON. Filters:
+        ?n=, ?subsystem=, ?trace_id= (one end-to-end trace)."""
+        from ..libs import trace
+
+        try:
+            n = int(request.query["n"]) if "n" in request.query else None
+            trace_id = (
+                int(request.query["trace_id"])
+                if "trace_id" in request.query
+                else None
+            )
+        except ValueError:
+            return web.Response(status=400, text="bad n/trace_id\n")
+        spans = trace.RECORDER.dump(
+            n, subsystem=request.query.get("subsystem"), trace_id=trace_id
+        )
+        return web.json_response(
+            {"stats": trace.RECORDER.stats(), "spans": spans}
+        )
+
+    async def _handle_flight(self, request: web.Request) -> web.Response:
+        """Flight-recorder status; ?dump=reason forces a dump (the same
+        path a wedge/breaker-trip takes automatically)."""
+        from ..libs import trace
+
+        reason = request.query.get("dump")
+        if reason:
+            path = trace.auto_dump(f"manual-{reason}")
+            return web.json_response(
+                {"dumped": True, "path": path, "stats": trace.RECORDER.stats()}
+            )
+        return web.json_response({"stats": trace.RECORDER.stats()})
 
     # -- live profiling (reference pprof-laddr, config/config.go:529) ----
 

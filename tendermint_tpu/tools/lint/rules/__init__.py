@@ -5,13 +5,20 @@ JSON output pick it up by its `id` with no further wiring."""
 
 from __future__ import annotations
 
-from . import async_rules, chokepoint_rules, clock_rules, nondeterminism_rules
+from . import (
+    async_rules,
+    chokepoint_rules,
+    clock_rules,
+    nondeterminism_rules,
+    trace_rules,
+)
 
 ALL_RULES = (
     *async_rules.RULES,
     *chokepoint_rules.RULES,
     *clock_rules.RULES,
     *nondeterminism_rules.RULES,
+    *trace_rules.RULES,
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -275,9 +275,9 @@ class TestBackpressure:
         gate = asyncio.Event()
         orig_classify = cs.ingest._classify
 
-        async def gated(mi):
+        async def gated(mi, ctx=None):
             await gate.wait()
-            return await orig_classify(mi)
+            return await orig_classify(mi, ctx)
 
         cs.ingest._classify = gated
         loop = asyncio.get_running_loop()
@@ -373,6 +373,13 @@ class TestLanes:
         h._verify_batch = record
         h.start()
         try:
+            # hold both double-buffer slots: the dispatcher blocks at its
+            # pack-at-last-moment acquire until every submission is
+            # queued. Without this, 6 queued backfill (>= max_batch)
+            # short-circuits the window wait and the packer can fire
+            # between the two live submits under machine load.
+            h._slots.acquire()
+            h._slots.acquire()
             futs = [
                 h.submit_nowait(pk, m, s, lane=LANE_BACKFILL)
                 for pk, m, s in _items(6, b"bf")
@@ -381,6 +388,8 @@ class TestLanes:
                 h.submit_nowait(pk, m, s, lane=LANE_LIVE)
                 for pk, m, s in _items(2, b"live")
             ]
+            h._slots.release()
+            h._slots.release()
             h.flush()
             for f in futs:
                 assert f.result(10.0) is True

@@ -534,6 +534,100 @@ def test_set_iteration_flagged_sorted_clean():
 
 
 # ---------------------------------------------------------------------------
+# span-discipline
+
+
+def test_span_outside_with_flagged():
+    # held in a variable (never closed) and dropped on the floor — both
+    # leak the measurement
+    src = """
+    from tendermint_tpu.libs import trace
+    def f():
+        sp = trace.span("hub", "dispatch")
+        trace.span("hub", "queue")
+    """
+    assert {f.line for f in run(src, "span-discipline")} == {4, 5}
+
+
+def test_span_in_with_clean():
+    src = """
+    from tendermint_tpu.libs import trace
+    def f():
+        with trace.span("hub", "dispatch") as sp:
+            sp.set(batch=4)
+        with trace.RECORDER.span("hub", "queue"):
+            pass
+    """
+    assert run(src, "span-discipline") == []
+
+
+def test_span_discipline_record_emit_exempt():
+    # explicit-boundary APIs are closed by construction
+    src = """
+    from tendermint_tpu.libs import trace
+    def f(ctx, t0, t1):
+        trace.record(ctx, "consensus", "ingest.wait", t0, t1)
+        trace.emit("backend", "attach", duration_s=0.5)
+        trace.finish(ctx, "consensus", "msg")
+    """
+    assert run(src, "span-discipline") == []
+
+
+def test_recorder_span_outside_with_flagged():
+    src = """
+    def f(recorder):
+        leaked = recorder.span("hub", "x")
+    """
+    assert len(run(src, "span-discipline")) == 1
+
+
+def test_unrelated_span_method_clean():
+    # a .span() on a non-recorder receiver is not a trace span
+    src = """
+    def f(wing):
+        area = wing.span("m")
+    """
+    assert run(src, "span-discipline") == []
+
+
+def test_wall_clock_in_trace_layer_flagged():
+    src = """
+    import time
+    def stamp():
+        return time.time()
+    """
+    fs = run(src, "span-discipline", rel="tendermint_tpu/libs/trace.py")
+    assert len(fs) == 1 and "wall-clock" in fs[0].message
+    # time.monotonic is the duration domain — legal in the trace layer
+    src_ok = """
+    import time
+    def dur():
+        return time.monotonic()
+    """
+    assert run(src_ok, "span-discipline", rel="tendermint_tpu/libs/trace.py") == []
+    # and wall clocks OUTSIDE the trace layer are other rules' business
+    assert run(src, "span-discipline", rel="tendermint_tpu/rpc/core.py") == []
+
+
+def test_watchdog_wall_clock_allowlisted():
+    src = """
+    import time
+    def report_name():
+        return f"wedged-{int(time.time()*1000)}.txt"
+    """
+    allow = Allowlist.load(DEFAULT_ALLOWLIST)
+    assert (
+        lint_source(
+            textwrap.dedent(src),
+            "tendermint_tpu/libs/watchdog.py",
+            [RULES_BY_ID["span-discipline"]],
+            allow,
+        )
+        == []
+    )
+
+
+# ---------------------------------------------------------------------------
 # pragmas
 
 
