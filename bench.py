@@ -873,6 +873,144 @@ def bench_consensus_ingest(n_vals: int = 64, waves: int = 6, n_peers: int = 8) -
     return asyncio.run(_bench_consensus_ingest_async(n_vals, waves, n_peers))
 
 
+async def _bench_tx_flood_async(n_clients: int, txs_per_client: int) -> dict:
+    """tx_flood config: open-loop flood of signed-envelope txs from
+    `n_clients` distinct senders through the TxIngress front door —
+    sustained admitted tx/s, per-tx p99 admission (CheckTx) latency and
+    the shed rate under explicit backpressure. Clients are OPEN loop:
+    they submit without waiting for verdicts (bursts with a cooperative
+    yield), so when the bounded intake fills the ingress must shed with
+    busy, never buffer; the pipeline keeps draining behind the flood and
+    the number that matters is what it sustains, not what it drops."""
+    import asyncio
+
+    from tendermint_tpu.abci import types as abci_types
+    from tendermint_tpu.abci.application import BaseApplication
+    from tendermint_tpu.abci.client import LocalClient
+    from tendermint_tpu.config import MempoolConfig
+    from tendermint_tpu.crypto import verify_hub as vh
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.mempool.ingress import TxIngress, make_signed_tx
+    from tendermint_tpu.mempool.pool import PriorityMempool
+
+    class FloodApp(BaseApplication):
+        def check_tx(self, req):
+            # pseudo-random priority per tx (last byte = signature
+            # tail): cheap variety in the resident ordering without an
+            # app-state lookup; the pool is sized to hold the whole
+            # flood, so eviction dynamics are tested in the suite, not
+            # measured here
+            prio = req.tx[-1] if req.tx else 0
+            return abci_types.ResponseCheckTx(priority=prio, gas_wanted=1)
+
+    n_total = n_clients * txs_per_client
+    log(f"tx flood: signing {n_total} envelope txs from {n_clients} clients …")
+    t0 = time.perf_counter()
+    keys = [Ed25519PrivKey.generate() for _ in range(n_clients)]
+    txs: list[bytes] = []
+    for ci, key in enumerate(keys):
+        for nonce in range(txs_per_client):
+            txs.append(
+                make_signed_tx(key, nonce, b"flood-%d-%d" % (ci, nonce))
+            )
+    sign_dt = time.perf_counter() - t0
+    log(f"signed {n_total} txs in {sign_dt:.1f}s")
+
+    cfg = MempoolConfig(
+        # the pool must not be the bottleneck: this config measures the
+        # front door (intake/verify/nonce-lane/checktx), not eviction
+        size=n_total + 16,
+        max_txs_bytes=1 << 30,
+        cache_size=2 * n_total + 16,
+    )
+    # short park timeout: a shed nonce-0 makes its successor park, and
+    # the flood should measure drain speed, not 3s park clocks
+    cfg.ingress.nonce_park_timeout_ms = 250.0
+    # deep stage-A: concurrent verify awaits are what fill the hub's
+    # micro-batches (occupancy ~= workers under saturation)
+    cfg.ingress.verify_workers = 64
+    pool = PriorityMempool(cfg, LocalClient(FloodApp()))
+    ingress = TxIngress(cfg.ingress, pool)
+    await ingress.start()
+
+    latencies: list[float] = []
+
+    def on_done(fut, t_sub):
+        if fut.exception() is None:
+            latencies.append(time.perf_counter() - t_sub)
+
+    t0 = time.perf_counter()
+    burst = 256
+    for i in range(0, len(txs), burst):
+        for tx in txs[i : i + burst]:
+            t_sub = time.perf_counter()
+            fut = ingress.submit_nowait(tx, source="client")
+            fut.add_done_callback(lambda f, t=t_sub: on_done(f, t))
+        # open loop: yield so the pipeline runs, but never wait for it
+        await asyncio.sleep(0)
+    # drain: wait (bounded) for the pipeline + parked successors
+    deadline = time.perf_counter() + 120.0
+    while (
+        ingress.occupancy > 0 or ingress.parked_count() > 0
+    ) and time.perf_counter() < deadline:
+        await asyncio.sleep(0.01)
+    dt = time.perf_counter() - t0
+    stats = dict(ingress.stats)
+    admitted = int(pool.stats["admitted"])
+    shed = int(stats["shed"])
+    await ingress.stop()
+
+    latencies.sort()
+    p = lambda q: latencies[min(len(latencies) - 1, int(q * len(latencies)))] if latencies else 0.0  # noqa: E731
+    out = {
+        "clients": n_clients,
+        "txs_per_client": txs_per_client,
+        "submitted_total": n_total,
+        "admitted": admitted,
+        "admitted_tx_per_s": round(admitted / dt, 1),
+        "checktx_p50_ms": round(p(0.50) * 1e3, 3),
+        "checktx_p99_ms": round(p(0.99) * 1e3, 3),
+        "shed": shed,
+        "shed_rate": round(shed / n_total, 4),
+        "parked": int(stats["parked"]),
+        "park_expired": int(stats["park_expired"]),
+        "park_adopted": int(stats["park_adopted"]),
+        "sig_failed": int(stats["sig_failed"]),
+        "flood_dt_s": round(dt, 3),
+        "sign_dt_s": round(sign_dt, 1),
+    }
+    hub = vh.running_hub()
+    if hub is not None:
+        s = hub.stats()
+        out["hub_occupancy"] = round(s["mean_occupancy"], 2)
+        out["hub_backfill_sigs"] = int(s["lane_backfill_dispatched"])
+    log(
+        f"tx flood: {out['admitted_tx_per_s']:,.1f} admitted tx/s "
+        f"(p99 {out['checktx_p99_ms']}ms, shed {out['shed_rate']:.1%}, "
+        f"{admitted}/{n_total} admitted)"
+    )
+    return out
+
+
+async def _bench_tx_flood_with_hub(n_clients: int, txs_per_client: int) -> dict:
+    from tendermint_tpu.crypto import verify_hub as vh
+
+    # the hub IS the front door's verify engine: envelope signatures
+    # micro-batch on its backfill lane, so the flood must run against a
+    # live hub to measure the production path (acquired on this loop)
+    vh.acquire_hub(max_batch=512, window_ms=2.0, cache_size=65536)
+    try:
+        return await _bench_tx_flood_async(n_clients, txs_per_client)
+    finally:
+        vh.release_hub()
+
+
+def bench_tx_flood(n_clients: int = 10_000, txs_per_client: int = 2) -> dict:
+    import asyncio
+
+    return asyncio.run(_bench_tx_flood_with_hub(n_clients, txs_per_client))
+
+
 def main() -> None:
     import numpy as np
 
@@ -1033,6 +1171,18 @@ def main() -> None:
         extra["consensus_ingest"] = bench_consensus_ingest(64, waves, 8)
     except Exception as e:  # noqa: BLE001
         log(f"consensus-ingest bench failed: {e!r}")
+    # tx_flood runs on BOTH backends: the front-door admission pipeline
+    # (bounded intake -> batched envelope verify on the hub backfill
+    # lane -> nonce lanes -> CheckTx) under a 10k-client open-loop
+    # flood; CPU images scale the client count down like the other
+    # configs (pure-python signing would eat the driver budget)
+    try:
+        n_clients = 10_000 if backend != "cpu" else 300
+        extra["tx_flood"] = bench_tx_flood(
+            int(os.environ.get("TMTPU_BENCH_FLOOD_CLIENTS", str(n_clients))), 2
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"tx-flood bench failed: {e!r}")
     # crash_recovery runs on BOTH backends: WAL repair + replay is pure
     # host work, and recovery downtime is a headline robustness number
     try:

@@ -50,6 +50,32 @@ class ConsensusConfig:
 
 
 @dataclass
+class MempoolIngressConfig:
+    """TxIngress — the staged tx admission pipeline in front of the
+    priority mempool (mempool/ingress.py): bounded intake with explicit
+    backpressure, envelope signature pre-verification micro-batched
+    through the VerifyHub backfill lane, per-sender nonce lanes, and
+    deterministic in-order admission. TOML section `[mempool.ingress]`;
+    env mirrors (win over TOML, the VerifyHub contract):
+    TMTPU_INGRESS_DISABLE=1, TMTPU_INGRESS_DEPTH,
+    TMTPU_INGRESS_WORKERS, TMTPU_INGRESS_LANE_DEPTH,
+    TMTPU_INGRESS_PARK_MS."""
+
+    enabled: bool = True
+    # total occupancy bound from accepted submit to insert/park: a full
+    # pipeline rejects-with-busy (shed) instead of buffering unboundedly
+    depth: int = 2048
+    # concurrent stage-A (parse + signature pre-verify) workers; the
+    # reorder buffer restores strict arrival order behind them
+    verify_workers: int = 8
+    # parked out-of-order txs per sender nonce lane
+    nonce_lane_depth: int = 32
+    # a nonce gap older than this (injected-clock wall domain) evicts
+    # every tx parked behind it
+    nonce_park_timeout_ms: float = 3000.0
+
+
+@dataclass
 class MempoolConfig:
     """Reference config/config.go:800-860."""
 
@@ -62,6 +88,14 @@ class MempoolConfig:
     broadcast: bool = True
     ttl_num_blocks: int = 0
     ttl_duration_ns: int = 0
+    # post-commit re-CheckTx batch width: the resident set is re-checked
+    # in concurrent slices of this many ABCI calls instead of N
+    # sequential round-trips (mempool/pool.py _recheck)
+    recheck_batch: int = 64
+    # max peers each resident tx is gossiped to (0 = unlimited); the
+    # reactor also never echoes a tx back to the peer(s) it arrived from
+    gossip_fanout: int = 8
+    ingress: MempoolIngressConfig = field(default_factory=MempoolIngressConfig)
 
 
 @dataclass
@@ -207,14 +241,19 @@ class Config:
 
 def _section_to_toml(name: str, obj) -> str:
     lines = [f"[{name}]"]
+    nested: list[str] = []
     for k, v in obj.__dict__.items():
-        if isinstance(v, bool):
+        if hasattr(v, "__dataclass_fields__"):
+            # nested section ([mempool.ingress]) — TOML requires it to
+            # come after the parent table's own keys
+            nested.append(_section_to_toml(f"{name}.{k}", v))
+        elif isinstance(v, bool):
             lines.append(f"{k} = {'true' if v else 'false'}")
         elif isinstance(v, (int, float)):
             lines.append(f"{k} = {v}")
         else:
             lines.append(f'{k} = "{v}"')
-    return "\n".join(lines)
+    return "\n".join(lines + ([""] if nested else []) + nested)
 
 
 def config_to_toml(cfg: Config) -> str:
@@ -269,7 +308,16 @@ def config_from_toml(text: str) -> Config:
         ("verify_hub", cfg.verify_hub),
         ("trace", cfg.trace),
     ):
-        for k, v in data.get(section, {}).items():
-            if hasattr(obj, k):
-                setattr(obj, k, v)
+        _apply_section(obj, data.get(section, {}))
     return cfg
+
+
+def _apply_section(obj, values: dict) -> None:
+    for k, v in values.items():
+        if not hasattr(obj, k):
+            continue
+        cur = getattr(obj, k)
+        if isinstance(v, dict) and hasattr(cur, "__dataclass_fields__"):
+            _apply_section(cur, v)  # nested table, e.g. [mempool.ingress]
+        elif not isinstance(v, dict):
+            setattr(obj, k, v)

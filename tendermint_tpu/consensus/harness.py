@@ -137,6 +137,8 @@ class Node:
     async def stop(self) -> None:
         if self.cs is not None:
             await self.cs.stop()
+        if self.mempool is not None:
+            self.mempool.close()  # out of the process-wide metrics fold
         await self.app_conns.stop()
 
 

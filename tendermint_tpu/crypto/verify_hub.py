@@ -647,6 +647,31 @@ def running_hub() -> VerifyHub | None:
     return hub if hub is not None and hub.is_running else None
 
 
+async def averify_one(
+    pub_key: PubKey,
+    msg: bytes,
+    sig: bytes,
+    *,
+    lane: str = LANE_LIVE,
+    trace_ctx=None,
+) -> bool:
+    """Async single-signature chokepoint (the coroutine-safe sibling of
+    `verify_one`, used by the tx-ingress pipeline): awaits the batched
+    verdict through the running hub — dedup cache + coalescing, zero
+    event-loop blocking — and degrades to inline host verification when
+    no hub is up or the hub errors, exactly like `verify_one`."""
+    hub = running_hub()
+    if hub is None:
+        return pub_key.verify_signature(msg, sig)
+    try:
+        return await hub.verify(pub_key, msg, sig, lane=lane, trace_ctx=trace_ctx)
+    except asyncio.CancelledError:
+        raise
+    except Exception as e:  # noqa: BLE001 — timeout/shutdown races
+        logger.warning("hub verify failed (%r); verifying inline", e)
+        return pub_key.verify_signature(msg, sig)
+
+
 def verify_one(
     pub_key: PubKey, msg: bytes, sig: bytes, *, lane: str = LANE_LIVE
 ) -> bool:

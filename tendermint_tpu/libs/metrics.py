@@ -229,9 +229,83 @@ class NodeMetrics:
         self.consensus_byzantine = r.counter(
             "consensus", "byzantine_validators", "equivocations seen"
         )
-        # mempool
+        # mempool + tx ingress (mempool/pool.py, mempool/ingress.py —
+        # live pools/ingresses registered process-wide, folded in at
+        # render time like the verifyhub families: a tx flood is
+        # diagnosable from one /metrics scrape alone)
         self.mempool_size = r.gauge("mempool", "size", "resident txs")
         self.mempool_failed = r.counter("mempool", "failed_txs", "rejected txs")
+        self.mempool_bytes = r.gauge("mempool", "bytes", "resident tx bytes")
+        self.mempool_tx_admitted = r.counter(
+            "mempool", "tx_admitted", "txs inserted into the resident set"
+        )
+        self.mempool_tx_rejected = r.counter(
+            "mempool", "tx_rejected",
+            "txs rejected (size/malformed/bad-sig/stale-nonce/CheckTx/full)",
+        )
+        self.mempool_tx_evicted = r.counter(
+            "mempool", "tx_evicted", "residents displaced by higher priority"
+        )
+        self.mempool_tx_shed = r.counter(
+            "mempool", "tx_shed",
+            "txs rejected-with-busy at the ingress intake (backpressure)",
+        )
+        self.mempool_tx_recheck_failed = r.counter(
+            "mempool", "tx_recheck_failed",
+            "residents dropped by the post-commit batched recheck",
+        )
+        from ..mempool.ingress import ADMIT_BUCKETS
+
+        self.ingress_submitted = r.counter(
+            "ingress", "submitted", "txs accepted into the admission pipeline"
+        )
+        self.ingress_dedup_drops = r.counter(
+            "ingress", "dedup_drops",
+            "duplicate submissions dropped before any verify/CheckTx work",
+        )
+        self.ingress_sig_failed = r.counter(
+            "ingress", "sig_failed", "envelope signature pre-verify failures"
+        )
+        self.ingress_parked = r.counter(
+            "ingress", "parked", "nonce-gap arrivals parked in a sender lane"
+        )
+        self.ingress_park_expired = r.counter(
+            "ingress", "park_expired", "parked txs evicted on nonce-gap timeout"
+        )
+        self.ingress_park_adopted = r.counter(
+            "ingress", "park_adopted",
+            "fresh-lane parked txs adopted as the lane start on timeout",
+        )
+        self.ingress_stale_nonce = r.counter(
+            "ingress", "stale_nonce", "txs below their sender lane watermark"
+        )
+        self.ingress_lane_full = r.counter(
+            "ingress", "lane_full", "txs rejected busy at a full nonce lane"
+        )
+        self.ingress_depth = r.gauge(
+            "ingress", "depth", "txs currently inside the bounded pipeline"
+        )
+        self.ingress_parked_now = r.gauge(
+            "ingress", "parked_now", "txs currently parked across nonce lanes"
+        )
+        self.ingress_admit_latency = r.histogram(
+            "ingress",
+            "admit_latency_seconds",
+            "submit-to-insert latency per admitted tx",
+            buckets=ADMIT_BUCKETS,
+        )
+        self.ingress_verify_latency = r.histogram(
+            "ingress",
+            "verify_latency_seconds",
+            "stage-A parse + signature pre-verify latency per tx",
+            buckets=ADMIT_BUCKETS,
+        )
+        # event fan-out (libs/pubsub.py drop_on_full subscriptions —
+        # the websocket path; folded from pubsub.DROPPED at render)
+        self.pubsub_dropped_events = r.counter(
+            "pubsub", "dropped_events",
+            "events dropped for slow drop-on-full subscribers (websocket fan-out)",
+        )
         # p2p
         self.p2p_peers = r.gauge("p2p", "peers", "connected peers")
         self.p2p_msg_recv = r.counter("p2p", "message_receive_bytes_total", "inbound bytes")
@@ -463,6 +537,50 @@ class NodeMetrics:
                 dst._sum = sum_
                 dst._count = count
 
+    def _fold_mempool(self) -> None:
+        from ..libs import pubsub
+        from ..mempool import ingress as mp_ingress
+        from ..mempool import pool as mp_pool
+
+        self.pubsub_dropped_events._values[()] = pubsub.DROPPED["events"]
+        agg = mp_pool.aggregate_pools()
+        ing, admit_hist, verify_hist = mp_ingress.aggregate()
+        if agg is not None:
+            stats, size, size_bytes = agg
+            self.mempool_size.set(size)
+            self.mempool_bytes.set(size_bytes)
+            self.mempool_tx_admitted._values[()] = stats["admitted"]
+            self.mempool_tx_evicted._values[()] = stats["evicted"]
+            self.mempool_tx_recheck_failed._values[()] = stats["recheck_failed"]
+            # rejections: pool-level (size/CheckTx/full) + ingress-level
+            # (malformed/bad-sig/stale-nonce/park-expired) are disjoint —
+            # an ingress rejection never reaches the pool
+            self.mempool_tx_rejected._values[()] = stats["rejected"] + (
+                ing["rejected"] if ing is not None else 0.0
+            )
+        if ing is None:
+            return
+        self.mempool_tx_shed._values[()] = ing["shed"]
+        self.ingress_submitted._values[()] = ing["submitted"]
+        self.ingress_dedup_drops._values[()] = ing["dedup_drops"]
+        self.ingress_sig_failed._values[()] = ing["sig_failed"]
+        self.ingress_parked._values[()] = ing["parked"]
+        self.ingress_park_expired._values[()] = ing["park_expired"]
+        self.ingress_park_adopted._values[()] = ing["park_adopted"]
+        self.ingress_stale_nonce._values[()] = ing["stale_nonce"]
+        self.ingress_lane_full._values[()] = ing["lane_full"]
+        self.ingress_depth.set(ing["depth"])
+        self.ingress_parked_now.set(ing["parked_now"])
+        for src, dst in (
+            (admit_hist, self.ingress_admit_latency),
+            (verify_hist, self.ingress_verify_latency),
+        ):
+            counts, sum_, count = src
+            if len(counts) == len(dst._counts):  # same ADMIT_BUCKETS layout
+                dst._counts = counts
+                dst._sum = sum_
+                dst._count = count
+
     def _fold_steps(self) -> None:
         from ..consensus.state import aggregate_step_metrics
 
@@ -516,6 +634,7 @@ class NodeMetrics:
         self.wal_truncated_bytes._values[()] = STORAGE["wal_truncated_bytes"]
         self._fold_verify_hub()
         self._fold_ingest()
+        self._fold_mempool()
         self._fold_steps()
         self._fold_backend()
         return self.registry.render()

@@ -153,14 +153,26 @@ class Message:
 
 _CANCELLED = object()  # sentinel waking readers parked on the queue
 
+#: process-wide drop accounting for drop_on_full subscriptions (the
+#: websocket event fan-out); NodeMetrics folds it in at render time
+DROPPED: dict[str, float] = {"events": 0.0}
+
 
 class Subscription:
-    def __init__(self, subscriber: str, query: Query, buffer: int):
+    def __init__(
+        self, subscriber: str, query: Query, buffer: int,
+        drop_on_full: bool = False,
+    ):
         self.subscriber = subscriber
         self.query = query
         # +1 slot so the cancellation sentinel always fits
         self._queue: asyncio.Queue = asyncio.Queue(buffer + 1)
         self.cancelled: str | None = None  # cancellation reason
+        # drop-with-counter instead of cancel-the-laggard: a slow
+        # websocket consumer loses events (counted) but keeps its
+        # subscription — bounded fan-out, never an unbounded queue
+        self.drop_on_full = drop_on_full
+        self.dropped = 0
 
     def _cancel(self, reason: str) -> None:
         self.cancelled = reason
@@ -197,12 +209,13 @@ class PubSub:
         self._subs: dict[tuple[str, str], Subscription] = {}
 
     def subscribe(
-        self, subscriber: str, query: Query, buffer: int = 100
+        self, subscriber: str, query: Query, buffer: int = 100,
+        drop_on_full: bool = False,
     ) -> Subscription:
         key = (subscriber, str(query))
         if key in self._subs:
             raise ValueError(f"already subscribed: {key}")
-        sub = Subscription(subscriber, query, buffer)
+        sub = Subscription(subscriber, query, buffer, drop_on_full)
         self._subs[key] = sub
         return sub
 
@@ -225,8 +238,14 @@ class PubSub:
             if not sub.query.matches(events):
                 continue
             if sub._queue.qsize() >= sub._queue.maxsize - 1:
-                self._subs.pop(key, None)
-                sub._cancel("out of capacity")
+                if sub.drop_on_full:
+                    # slow subscriber: drop THIS event with a counter,
+                    # keep the subscription (websocket fan-out contract)
+                    sub.dropped += 1
+                    DROPPED["events"] += 1
+                else:
+                    self._subs.pop(key, None)
+                    sub._cancel("out of capacity")
             else:
                 sub._queue.put_nowait(msg)
 

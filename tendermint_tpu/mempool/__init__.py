@@ -9,8 +9,10 @@ MEMPOOL_CHANNEL = 0x30
 
 
 class Mempool:
-    async def check_tx(self, tx: bytes, sender: str = "") -> None:
-        """Validate a tx against the app and admit it. Raises on rejection."""
+    async def check_tx(self, tx: bytes, sender: str = "", trace_ctx=None) -> None:
+        """Validate a tx against the app and admit it. Raises on rejection.
+        `trace_ctx` is an optional libs/trace TraceCtx handed through by
+        TxIngress so the admission path tiles end to end."""
         raise NotImplementedError
 
     def reap_max_bytes_max_gas(self, max_bytes: int, max_gas: int) -> list[bytes]:
@@ -53,7 +55,7 @@ class _NullLock:
 
 
 class NopMempool(Mempool):
-    async def check_tx(self, tx, sender=""):
+    async def check_tx(self, tx, sender="", trace_ctx=None):
         pass
 
     def reap_max_bytes_max_gas(self, max_bytes, max_gas):

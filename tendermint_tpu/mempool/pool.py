@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import weakref
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
@@ -22,7 +23,23 @@ from ..abci import types as abci
 from ..abci.client import Client
 from ..config import MempoolConfig
 from ..crypto.hashes import sha256
+from ..libs import trace
 from . import Mempool
+
+#: process-wide registry of live pools; NodeMetrics sums their stats at
+#: render time (the verifyhub/ingest fold pattern — multi-node
+#: in-process tests run several pools, one /metrics shows the funnel)
+_pools: "weakref.WeakSet[PriorityMempool]" = weakref.WeakSet()
+
+
+def aggregate_pools():
+    """Summed (stats, size, bytes) across every live pool, or None."""
+    pools = list(_pools)
+    if not pools:
+        return None
+    keys = pools[0].stats.keys()
+    s = {k: sum(p.stats[k] for p in pools) for k in keys}
+    return s, sum(p.size() for p in pools), sum(p.size_bytes() for p in pools)
 
 
 class TxCache:
@@ -79,6 +96,7 @@ class WrappedTx:
     seq: int  # arrival order (FIFO tie-break)
     time_ns: int = 0
     peers: set[str] = field(default_factory=set)
+    gossiped: int = 0  # peers this tx was sent to (fan-out cap)
 
     def sort_key(self):
         return (-self.priority, self.seq)
@@ -98,10 +116,22 @@ class PriorityMempool(Mempool):
         self.height = height
         self.logger = logger or logging.getLogger("mempool")
         self.cache = TxCache(config.cache_size)
+        # hashes of txs committed in a block: an admission whose CheckTx
+        # was in flight across that commit must NOT resurrect them (the
+        # update/check_tx interleaving class — see check_tx)
+        self._committed = TxCache(config.cache_size)
         self._txs: dict[bytes, WrappedTx] = {}  # hash -> wtx
         self._bytes = 0
         self._seq = itertools.count()
         self._lock = asyncio.Lock()
+        # flood observability, folded into /metrics at render time
+        self.stats: dict[str, float] = {
+            "admitted": 0.0,   # txs inserted into the resident set
+            "rejected": 0.0,   # CheckTx/size rejections (full pool incl.)
+            "evicted": 0.0,    # residents displaced by higher priority
+            "recheck_failed": 0.0,  # residents dropped by post-commit recheck
+        }
+        _pools.add(self)
         # set when txs are available; consensus wait-for-txs hook
         self._txs_available: asyncio.Event = asyncio.Event()
         self.notified_txs_available = False
@@ -111,30 +141,67 @@ class PriorityMempool(Mempool):
 
     # -- admission -------------------------------------------------------
 
-    async def check_tx(self, tx: bytes, sender: str = "") -> None:
+    async def check_tx(
+        self, tx: bytes, sender: str = "", trace_ctx=None
+    ) -> None:
         if len(tx) > self.config.max_tx_bytes:
+            self.stats["rejected"] += 1
             raise TxRejectedError(0, f"tx too large ({len(tx)} bytes)")
+        if self._committed.has(tx):
+            raise TxInCacheError("tx already committed")
         if not self.cache.push(tx):
             # seen before: record the extra gossip sender, reject
             wtx = self._txs.get(sha256(tx))
             if wtx is not None and sender:
                 wtx.peers.add(sender)
             raise TxInCacheError("tx already in cache")
+        # checktx/insert trace stages (TxIngress hands its trace ctx
+        # through so the admission path tiles end to end): the checktx
+        # span starts at the nonce-lane boundary mark the ingress left,
+        # so stage durations share boundaries and sum exactly
+        t_ck0 = (
+            trace_ctx.marks.pop("checktx_start", trace_ctx.clock.monotonic())
+            if trace_ctx is not None
+            else 0.0
+        )
         res = await self.app.check_tx(abci.RequestCheckTx(tx))
+        if trace_ctx is not None:
+            t_ck1 = trace_ctx.clock.monotonic()
+            trace.record(trace_ctx, "mempool.ingress", "checktx", t_ck0, t_ck1)
         if not res.is_ok():
+            self.stats["rejected"] += 1
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(tx)
             raise TxRejectedError(res.code, res.log)
-        wtx = WrappedTx(
-            tx=tx,
-            hash=sha256(tx),
-            height=self.height,
-            priority=res.priority,
-            gas_wanted=res.gas_wanted,
-            sender=res.sender or sender,
-            seq=next(self._seq),
-        )
-        self._insert(wtx)
+        # insert + eviction are one atomic section against update(): the
+        # executor commits holding lock(), so an admission whose CheckTx
+        # straddled that commit can neither double-count _bytes against a
+        # concurrent eviction nor resurrect a tx the commit just removed
+        async with self._lock:
+            if self._committed.has(tx):
+                # committed while our CheckTx round-trip was in flight
+                raise TxInCacheError("tx committed during admission")
+            wtx = WrappedTx(
+                tx=tx,
+                hash=sha256(tx),
+                height=self.height,
+                priority=res.priority,
+                gas_wanted=res.gas_wanted,
+                sender=res.sender or sender,
+                seq=next(self._seq),
+                # the gossip source already has this tx: never echo it back
+                peers={sender} if sender else set(),
+            )
+            try:
+                self._insert(wtx)
+            except MempoolFullError:
+                self.stats["rejected"] += 1
+                raise
+            self.stats["admitted"] += 1
+            if trace_ctx is not None:
+                t_ins = trace_ctx.clock.monotonic()
+                trace.record(trace_ctx, "mempool.ingress", "insert", t_ck1, t_ins)
+                trace_ctx.marks["insert_end"] = t_ins
 
     def _insert(self, wtx: WrappedTx) -> None:
         if wtx.hash in self._txs:
@@ -151,6 +218,7 @@ class PriorityMempool(Mempool):
                     f"mempool full ({len(self._txs)} txs, {self._bytes} bytes)"
                 )
             self._remove(victim.hash, remove_from_cache=True)
+            self.stats["evicted"] += 1
             self.logger.debug("evicted tx %s", victim.hash.hex()[:12])
         self._txs[wtx.hash] = wtx
         self._bytes += len(wtx.tx)
@@ -204,6 +272,10 @@ class PriorityMempool(Mempool):
             committed_ok = i < len(results) and results[i].is_ok()
             if committed_ok:
                 self.cache.push(tx)  # keep committed txs in cache
+                # remember the commit: an admission in flight across this
+                # update must not re-insert the tx (check_tx re-checks
+                # this under lock after its ABCI round-trip)
+                self._committed.push(tx)
             else:
                 self.cache.remove(tx)
             self._remove(sha256(tx), remove_from_cache=False)
@@ -218,18 +290,37 @@ class PriorityMempool(Mempool):
 
     async def _recheck(self) -> None:
         """Re-run CheckTx(RECHECK) on all resident txs after a block
-        changed app state (reference recheckTxs v1/mempool.go:540)."""
-        for wtx in self._ordered():
-            res = await self.app.check_tx(
-                abci.RequestCheckTx(wtx.tx, abci.CheckTxType.RECHECK)
-            )
-            if not res.is_ok():
-                self._remove(
-                    wtx.hash,
-                    remove_from_cache=not self.config.keep_invalid_txs_in_cache,
+        changed app state (reference recheckTxs v1/mempool.go:540).
+
+        Micro-batched: the resident set is re-checked in concurrent
+        slices of `recheck_batch` ABCI calls instead of N sequential
+        round-trips, so post-commit recheck latency scales with the
+        slowest call per slice, not the sum. Results are applied in
+        priority order regardless of completion order (gather preserves
+        submission order), so the surviving set is deterministic."""
+        entries = self._ordered()
+        width = max(1, self.config.recheck_batch)
+        for i in range(0, len(entries), width):
+            chunk = entries[i : i + width]
+            results = await asyncio.gather(
+                *(
+                    self.app.check_tx(
+                        abci.RequestCheckTx(w.tx, abci.CheckTxType.RECHECK)
+                    )
+                    for w in chunk
                 )
-            else:
-                wtx.priority = res.priority
+            )
+            for wtx, res in zip(chunk, results):
+                if wtx.hash not in self._txs:
+                    continue  # displaced while the slice was in flight
+                if not res.is_ok():
+                    self._remove(
+                        wtx.hash,
+                        remove_from_cache=not self.config.keep_invalid_txs_in_cache,
+                    )
+                    self.stats["recheck_failed"] += 1
+                else:
+                    wtx.priority = res.priority
 
     def size(self) -> int:
         return len(self._txs)
@@ -249,6 +340,25 @@ class PriorityMempool(Mempool):
 
     def has_tx(self, hash_: bytes) -> bool:
         return hash_ in self._txs
+
+    def close(self) -> None:
+        """Deregister from the process-wide metrics fold: a stopped
+        node's pool must not keep contributing residents to /metrics
+        (the ingress registry filters on is_running; pools are not
+        Services, so owners call this from their stop path)."""
+        _pools.discard(self)
+
+    def is_committed(self, tx: bytes) -> bool:
+        """True when `tx` was committed in a recent block (bounded LRU):
+        admission layers reject these before any verify/ABCI work."""
+        return self._committed.has(tx)
+
+    def note_peer(self, hash_: bytes, peer: str) -> None:
+        """Record that `peer` already has this tx (gossip duplicate):
+        the broadcast loop will never echo it back there."""
+        wtx = self._txs.get(hash_)
+        if wtx is not None and peer:
+            wtx.peers.add(peer)
 
     async def wait_for_txs(self) -> None:
         await self._txs_available.wait()

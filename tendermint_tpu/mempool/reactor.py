@@ -3,7 +3,15 @@ channel 0x30).
 
 Each peer gets a broadcast task walking the mempool in priority order and
 sending txs that peer hasn't been seen to have (either from us earlier or
-because the peer itself sent it to us — tracked in WrappedTx.peers)."""
+because the peer itself sent it to us — tracked in WrappedTx.peers).
+Per-tx fan-out is capped (`MempoolConfig.gossip_fanout`): once a tx has
+been pushed to that many peers the rest rely on transitive gossip, so a
+flood costs each node O(fanout) sends per tx, not O(peers).
+
+Inbound txs route through TxIngress when the node runs one: dedup +
+signature pre-verification happen BEFORE the ABCI CheckTx round-trip,
+and a busy pipeline sheds (the peer re-offers later) instead of
+buffering unboundedly."""
 
 from __future__ import annotations
 
@@ -16,6 +24,7 @@ from ..p2p.peermanager import PeerStatus
 from ..p2p.router import Channel
 from ..p2p.types import Envelope, PeerError
 from . import MEMPOOL_CHANNEL
+from .ingress import TxIngress
 from .pool import PriorityMempool, TxInCacheError, TxRejectedError
 
 BROADCAST_SLEEP = 0.05
@@ -44,11 +53,13 @@ class MempoolReactor(Service):
         channel: Channel,
         peer_updates: asyncio.Queue,
         *,
+        ingress: TxIngress | None = None,
         broadcast: bool = True,
         logger: logging.Logger | None = None,
     ):
         super().__init__("mp-reactor", logger)
         self.mempool = mempool
+        self.ingress = ingress
         self.channel = channel
         self.peer_updates = peer_updates
         self.broadcast = broadcast
@@ -81,6 +92,16 @@ class MempoolReactor(Service):
 
     async def _process_inbound(self) -> None:
         async for env in self.channel:
+            if self.ingress is not None:
+                # staged admission, fire-and-forget: dedup + signature
+                # pre-verify happen before any tx costs an ABCI
+                # round-trip, a full pipeline sheds (the peer re-offers),
+                # and a parked nonce-gap tx never stalls this loop. The
+                # ingress pre-retrieves every rejection future's
+                # exception, so dropping the handle leaks nothing.
+                for tx in env.message:
+                    self.ingress.submit_nowait(tx, source=env.from_)
+                continue
             for tx in env.message:
                 try:
                     await self.mempool.check_tx(tx, sender=env.from_)
@@ -93,22 +114,39 @@ class MempoolReactor(Service):
 
     async def _broadcast_to(self, peer_id: str) -> None:
         """Reference broadcastTxRoutine: walk resident txs, skip ones the
-        peer already has."""
+        peer already has (sent by us earlier, or the peer was a gossip
+        source — WrappedTx.peers — so it is never echoed its own tx) and
+        ones already pushed to `gossip_fanout` peers."""
         sent = self._sent[peer_id]
+        fanout = self.mempool.config.gossip_fanout
         while True:
-            batch, hashes = [], []
+            batch, picked = [], []
             for wtx in self.mempool.all_entries():
                 if wtx.hash in sent or peer_id in wtx.peers:
                     continue
+                if fanout > 0 and wtx.gossiped >= fanout:
+                    continue  # fan-out cap: transitive gossip covers the rest
+                # claim the fan-out slot at selection (before any await):
+                # concurrent per-peer tasks must not all pick the same tx
+                wtx.gossiped += 1
+                sent.add(wtx.hash)
                 batch.append(wtx.tx)
-                hashes.append(wtx.hash)
+                picked.append(wtx)
                 if len(batch) >= 100:
                     break
             if batch:
-                # awaited put: backpressure instead of silent tx loss
-                await self.channel.out_q.put(
-                    Envelope(MEMPOOL_CHANNEL, batch, to=peer_id)
-                )
-                sent.update(hashes)
+                try:
+                    # awaited put: backpressure instead of silent tx loss
+                    await self.channel.out_q.put(
+                        Envelope(MEMPOOL_CHANNEL, batch, to=peer_id)
+                    )
+                except asyncio.CancelledError:
+                    # peer went DOWN mid-send: give the claimed fan-out
+                    # slots back, or churn could exhaust a tx's budget
+                    # with zero deliveries
+                    for wtx in picked:
+                        wtx.gossiped -= 1
+                        sent.discard(wtx.hash)
+                    raise
             else:
                 await asyncio.sleep(BROADCAST_SLEEP)

@@ -37,6 +37,7 @@ from .evidence.pool import EvidencePool
 from .evidence.reactor import EvidenceReactor
 from .libs.service import Service
 from .mempool import MEMPOOL_CHANNEL
+from .mempool.ingress import TxIngress
 from .mempool.pool import PriorityMempool
 from .mempool.reactor import MempoolReactor, decode_txs, encode_txs
 from .p2p.peermanager import PeerManager
@@ -184,6 +185,7 @@ class Node(Service):
         self.consensus: ConsensusState | None = None
         self.cs_reactor: ConsensusReactor | None = None
         self.mempool: PriorityMempool | None = None
+        self.ingress: TxIngress | None = None
         self.mempool_reactor: MempoolReactor | None = None
         self.evidence_pool: EvidencePool | None = None
         self.evidence_reactor: EvidenceReactor | None = None
@@ -422,6 +424,26 @@ class Node(Service):
             # clock-skew fault class: the validator's own wall clock is
             # deterministically wrong (seeded per node id)
             clock = self.chaos_net.clock_for(self.node_id, base=clock)
+        ingress_disabled = os.environ.get(
+            "TMTPU_INGRESS_DISABLE", ""
+        ).lower() not in ("", "0", "false")
+        if self.config.mempool.ingress.enabled and not ingress_disabled:
+            # the production front door: RPC broadcast_tx_* and p2p
+            # gossip both admit through the staged pipeline (bounded
+            # intake, batched signature pre-verify on the hub's backfill
+            # lane, per-sender nonce lanes)
+            self.ingress = TxIngress(
+                self.config.mempool.ingress,
+                self.mempool,
+                clock=clock,
+                logger=self.logger.getChild("ingress"),
+            )
+            self.logger.info(
+                "tx ingress enabled (depth=%d, workers=%d, hub=%s)",
+                self.ingress.depth,
+                self.ingress.verify_workers,
+                "on" if self.verify_hub is not None else "off",
+            )
         self.consensus = ConsensusState(
             self.config.consensus,
             self.state,
@@ -452,7 +474,10 @@ class Node(Service):
             self.peer_manager.subscribe(),
         )
         self.mempool_reactor = MempoolReactor(
-            self.mempool, self.mempool_ch, self.peer_manager.subscribe()
+            self.mempool,
+            self.mempool_ch,
+            self.peer_manager.subscribe(),
+            ingress=self.ingress,
         )
         self.evidence_reactor = EvidenceReactor(
             self.evidence_pool, self.evidence_ch, self.peer_manager.subscribe()
@@ -530,6 +555,8 @@ class Node(Service):
 
         await self.router.start()
         await self.pex_reactor.start()
+        if self.ingress is not None:
+            await self.ingress.start()
         await self.mempool_reactor.start()
         await self.evidence_reactor.start()
         await self.statesync_reactor.start()
@@ -552,6 +579,7 @@ class Node(Service):
                 peer_manager=self.peer_manager,
                 node_info=self.node_info,
                 metrics=self.metrics,
+                ingress=self.ingress,
             )
             self.rpc_server = RPCServer(env, enable_pprof=self.config.rpc_pprof)
             host, _, port = self.config.rpc_laddr.rpartition(":")
@@ -646,6 +674,7 @@ class Node(Service):
             self.statesync_reactor,
             self.evidence_reactor,
             self.mempool_reactor,
+            self.ingress,
             self.pex_reactor,
             self.indexer,
             self.router,
@@ -657,6 +686,10 @@ class Node(Service):
                     # best-effort teardown: keep stopping the remaining
                     # services, but say which one failed
                     self.logger.warning("error stopping %s: %r", svc.name, e)
+        if self.mempool is not None:
+            # out of the process-wide /metrics fold: a stopped node's
+            # residents must not haunt the surviving nodes' scrape
+            self.mempool.close()
         try:
             self.peer_manager.save_addr_book()
             if not self.config.seed_mode:

@@ -13,9 +13,16 @@ from typing import Any
 from ..abci import types as abci
 from ..crypto.hashes import sha256
 from ..libs.pubsub import Query
-from ..mempool.pool import TxInCacheError, TxRejectedError
+from ..mempool.ingress import IngressBusyError
+from ..mempool.pool import MempoolFullError, TxInCacheError, TxRejectedError
 from ..state.indexer import KVSink
 from ..types.events import EventBus
+
+#: CheckTx code returned when the ingress pipeline sheds (explicit
+#: backpressure under tx flood) — clients should back off and resubmit;
+#: distinct from any app rejection code so a flood is diagnosable from
+#: the responses alone
+MEMPOOL_BUSY_CODE = 429
 
 
 class RPCError(Exception):
@@ -125,6 +132,10 @@ class Environment:
     peer_manager: Any = None
     node_info: Any = None
     metrics: Any = None  # NodeMetrics, rendered by /metrics
+    # TxIngress (mempool/ingress.py): when set, broadcast_tx_* routes
+    # through the staged admission pipeline (bounded intake, batched
+    # signature pre-verify, nonce lanes) instead of bare check_tx
+    ingress: Any = None
     logger: logging.Logger = field(default_factory=lambda: logging.getLogger("rpc"))
     # in-flight fire-and-forget CheckTx tasks (broadcast_tx_async): held
     # so they are reachable (cancellable, exceptions retrieved) instead
@@ -340,6 +351,14 @@ class Environment:
 
     async def broadcast_tx_async(self, tx: str) -> dict:
         raw = bytes.fromhex(tx)
+        if self.ingress is not None:
+            # fire-and-forget through the staged pipeline: the verdict
+            # future's exception is pre-retrieved by the ingress, so
+            # dropping the handle leaks nothing; a full pipeline sheds
+            # here synchronously (counted), which async mode swallows by
+            # contract (it promises no CheckTx result)
+            self.ingress.submit_nowait(raw, source="rpc")
+            return {"code": 0, "hash": _hex(sha256(raw)), "log": ""}
         t = asyncio.get_running_loop().create_task(self._checktx_quiet(raw))
         self._checktx_tasks.add(t)
         t.add_done_callback(self._checktx_tasks.discard)
@@ -356,9 +375,19 @@ class Environment:
     async def broadcast_tx_sync(self, tx: str) -> dict:
         raw = bytes.fromhex(tx)
         try:
-            await self.mempool.check_tx(raw)
+            if self.ingress is not None:
+                # per-mode verdict future: sync mode awaits the full
+                # admission verdict (verify -> nonce lane -> checktx ->
+                # insert), not just the ABCI round-trip
+                await self.ingress.submit_nowait(raw, source="rpc")
+            else:
+                await self.mempool.check_tx(raw)
         except TxInCacheError:
             return {"code": 0, "hash": _hex(sha256(raw)), "log": "tx already in cache"}
+        except (IngressBusyError, MempoolFullError) as e:
+            # explicit backpressure: the front door (or the pool behind
+            # it) is full — back off and resubmit, nothing was buffered
+            return {"code": MEMPOOL_BUSY_CODE, "hash": _hex(sha256(raw)), "log": str(e)}
         except TxRejectedError as e:
             return {"code": e.code or 1, "hash": _hex(sha256(raw)), "log": e.log}
         return {"code": 0, "hash": _hex(sha256(raw)), "log": ""}

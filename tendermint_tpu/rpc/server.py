@@ -294,7 +294,12 @@ class RPCServer:
                             _rpc_response(id_, error={"code": -32602, "message": str(e)})
                         )
                         continue
-                    sub = self.env.event_bus.subscribe(subscriber, q, buffer=256)
+                    # bounded fan-out: a slow websocket consumer loses
+                    # events (counted in pubsub.DROPPED / /metrics), it
+                    # never grows an unbounded queue or kills the sub
+                    sub = self.env.event_bus.subscribe(
+                        subscriber, q, buffer=256, drop_on_full=True
+                    )
                     pumps.append(
                         asyncio.get_running_loop().create_task(
                             self._pump(ws, id_, sub)

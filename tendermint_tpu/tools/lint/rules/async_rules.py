@@ -248,6 +248,62 @@ class AbsorbedCancellation(Rule):
 # ---------------------------------------------------------------------------
 
 
+class UnboundedQueue(Rule):
+    id = "unbounded-queue"
+    doc = (
+        "no unbounded asyncio.Queue() on the tx-ingress / event-fan-out "
+        "path (mempool/, rpc/, libs/pubsub.py) — a tx flood or slow "
+        "subscriber must hit explicit backpressure (bounded queue + "
+        "reject/drop-with-counter), never grow memory without bound"
+    )
+    #: the user-facing flood path: every queue here buffers work an
+    #: attacker can generate for free
+    scope = (
+        "tendermint_tpu/mempool/",
+        "tendermint_tpu/rpc/",
+        "tendermint_tpu/libs/pubsub.py",
+    )
+    profiles = ("node",)
+
+    QUEUE_TYPES = ("asyncio.Queue", "asyncio.LifoQueue", "asyncio.PriorityQueue")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if ctx.resolve_call(node) not in self.QUEUE_TYPES:
+                continue
+            maxsize = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    maxsize = kw.value
+            if self._is_unbounded(maxsize):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    "unbounded asyncio queue on the flood-facing path: a tx "
+                    "flood / slow event subscriber buffers without limit — "
+                    "pass a maxsize and shed (reject-busy / drop-with-"
+                    "counter) when full",
+                )
+
+    @staticmethod
+    def _is_unbounded(maxsize: ast.expr | None) -> bool:
+        """asyncio semantics: maxsize <= 0 (or absent) means infinite."""
+        if maxsize is None:
+            return True
+        if isinstance(maxsize, ast.Constant):
+            return maxsize.value is None or (
+                isinstance(maxsize.value, (int, float)) and maxsize.value <= 0
+            )
+        # -N parses as UnaryOp(USub, Constant(N)) — also unbounded
+        return (
+            isinstance(maxsize, ast.UnaryOp)
+            and isinstance(maxsize.op, ast.USub)
+            and isinstance(maxsize.operand, ast.Constant)
+        )
+
+
 class TaskLeak(Rule):
     id = "task-leak"
     doc = (
@@ -280,4 +336,4 @@ class TaskLeak(Rule):
                 )
 
 
-RULES = (BlockingInAsync(), AbsorbedCancellation(), TaskLeak())
+RULES = (BlockingInAsync(), AbsorbedCancellation(), UnboundedQueue(), TaskLeak())

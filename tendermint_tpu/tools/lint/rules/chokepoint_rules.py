@@ -41,11 +41,15 @@ class VerifyChokepoint(Rule):
     #: dirs where the pipelined ingest made the SYNC hub facade inside a
     #: coroutine a defect: it blocks the event loop on one signature and
     #: pins batch occupancy at 1 — use `await hub.verify(...)` (or hand
-    #: the work to the ingest pipeline / asyncio.to_thread)
+    #: the work to the ingest pipeline / asyncio.to_thread). mempool/
+    #: and rpc/ joined with TxIngress: the tx-flood front door lives on
+    #: the event loop and one sync verify stalls every admission
     ASYNC_SCOPES = (
         "tendermint_tpu/consensus/",
         "tendermint_tpu/blocksync/",
         "tendermint_tpu/statesync/",
+        "tendermint_tpu/mempool/",
+        "tendermint_tpu/rpc/",
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

@@ -109,8 +109,11 @@ class EventBus:
     def __init__(self):
         self.pubsub = PubSub()
 
-    def subscribe(self, subscriber: str, query: Query, buffer: int = 100) -> Subscription:
-        return self.pubsub.subscribe(subscriber, query, buffer)
+    def subscribe(
+        self, subscriber: str, query: Query, buffer: int = 100,
+        drop_on_full: bool = False,
+    ) -> Subscription:
+        return self.pubsub.subscribe(subscriber, query, buffer, drop_on_full)
 
     def unsubscribe(self, subscriber: str, query: Query) -> None:
         self.pubsub.unsubscribe(subscriber, query)

@@ -407,6 +407,73 @@ def test_sync_facade_pragma_escape_hatch():
     assert run(src, "verify-chokepoint", rel="tendermint_tpu/consensus/state.py") == []
 
 
+def test_sync_facade_flagged_in_mempool_and_rpc():
+    """TxIngress put mempool/ and rpc/ on the flood-facing event loop:
+    the sync hub facade (and direct verify) is a defect there too."""
+    src = """
+    async def admit(self, tx):
+        ok = self.hub.verify_sync(pk, msg, sig)
+    """
+    assert len(run(src, "verify-chokepoint", rel="tendermint_tpu/mempool/ingress.py")) == 1
+    assert len(run(src, "verify-chokepoint", rel="tendermint_tpu/rpc/core.py")) == 1
+
+
+# ---------------------------------------------------------------------------
+# unbounded-queue
+
+
+def test_unbounded_queue_flagged_on_flood_path():
+    """Every queue on the tx-ingress / event-fan-out path buffers work
+    an attacker generates for free — maxsize (plus shed-on-full) is
+    mandatory there."""
+    src = """
+    import asyncio
+    class Ingress:
+        def __init__(self):
+            self.q = asyncio.Queue()
+            self.q0 = asyncio.Queue(0)
+            self.qkw = asyncio.Queue(maxsize=0)
+            self.qneg = asyncio.Queue(-1)  # asyncio: <= 0 means infinite
+            self.qnegkw = asyncio.Queue(maxsize=-5)
+    """
+    for rel in (
+        "tendermint_tpu/mempool/ingress.py",
+        "tendermint_tpu/rpc/server.py",
+        "tendermint_tpu/libs/pubsub.py",
+    ):
+        assert {f.line for f in run(src, "unbounded-queue", rel=rel)} == {
+            5, 6, 7, 8, 9,
+        }
+
+
+def test_bounded_queue_and_out_of_scope_clean():
+    bounded = """
+    import asyncio
+    class Ingress:
+        def __init__(self, depth):
+            self.q = asyncio.Queue(depth)
+            self.q2 = asyncio.Queue(maxsize=depth + 1)
+    """
+    assert run(bounded, "unbounded-queue", rel="tendermint_tpu/mempool/ingress.py") == []
+    # consensus internals are bounded by protocol structure, not by this
+    # rule — the scope is the user-facing flood path only
+    unbounded = """
+    import asyncio
+    q = asyncio.Queue()
+    """
+    assert run(unbounded, "unbounded-queue", rel="tendermint_tpu/consensus/state.py") == []
+
+
+def test_unbounded_queue_from_import_cannot_evade():
+    src = """
+    from asyncio import Queue
+    class Sub:
+        def __init__(self):
+            self.q = Queue()
+    """
+    assert len(run(src, "unbounded-queue", rel="tendermint_tpu/rpc/core.py")) == 1
+
+
 def test_crypto_backends_allowlisted():
     src = """
     def check(pk, msg, sig):

@@ -1,0 +1,690 @@
+"""TxIngress — the staged tx-admission front door (mempool/ingress.py)
+plus the satellites that ride with it: the PriorityMempool admission
+race fix, batched post-commit recheck, gossip no-echo/fan-out, the
+drop-on-full event fan-out, and the RPC busy mapping.
+
+Covers the ISSUE 7 acceptance points: priority eviction under a full
+pool mid-flood, nonce-gap park/expiry (on a frozen ManualClock),
+duplicate handling across lanes, recheck-after-commit priority updates,
+trace-span tiling of the admission path, and a same-seed flood through
+a live (threaded) VerifyHub asserting bit-identical admitted-tx order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.application import BaseApplication
+from tendermint_tpu.abci.client import LocalClient
+from tendermint_tpu.config import MempoolConfig
+from tendermint_tpu.crypto import verify_hub as vh
+from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+from tendermint_tpu.libs import trace
+from tendermint_tpu.libs.clock import ManualClock
+from tendermint_tpu.libs.pubsub import PubSub, Query
+from tendermint_tpu.mempool.ingress import (
+    IngressBusyError,
+    TxEnvelope,
+    TxIngress,
+    decode_envelope,
+    encode_envelope,
+    make_signed_tx,
+)
+from tendermint_tpu.mempool.pool import (
+    PriorityMempool,
+    TxInCacheError,
+    TxRejectedError,
+)
+
+
+class PrioApp(BaseApplication):
+    """Priority = leading integer of `N:payload` txs (0 otherwise, and
+    for envelope txs); rejects txs containing b'bad'; on RECHECK,
+    rejects txs containing b'stale' and re-prices `N:reprice*` txs to
+    priority 100."""
+
+    def check_tx(self, req):
+        if b"bad" in req.tx:
+            return abci.ResponseCheckTx(code=1, log="bad tx")
+        if req.type == abci.CheckTxType.RECHECK and b"stale" in req.tx:
+            return abci.ResponseCheckTx(code=2, log="stale")
+        if req.type == abci.CheckTxType.RECHECK and b"reprice" in req.tx:
+            return abci.ResponseCheckTx(priority=100, gas_wanted=1)
+        try:
+            prio = int(req.tx.split(b":")[0])
+        except ValueError:
+            prio = 0
+        return abci.ResponseCheckTx(priority=prio, gas_wanted=1)
+
+
+def make_pool(**cfg) -> PriorityMempool:
+    return PriorityMempool(MempoolConfig(**cfg), LocalClient(PrioApp()))
+
+
+async def make_ingress(pool=None, clock=None, **knobs):
+    pool = pool or make_pool()
+    cfg = pool.config.ingress
+    for k, v in knobs.items():
+        setattr(cfg, k, v)
+    ing = TxIngress(cfg, pool, clock=clock)
+    await ing.start()
+    return ing, pool
+
+
+# ---------------------------------------------------------------------------
+# envelope codec
+
+
+def test_envelope_roundtrip_and_bare_passthrough():
+    k = Ed25519PrivKey.generate()
+    tx = make_signed_tx(k, 7, b"payload")
+    env = decode_envelope(tx)
+    assert env is not None
+    assert env.nonce == 7 and env.payload == b"payload"
+    assert env.key_type == k.TYPE and env.pub_key_bytes == k.pub_key().bytes()
+    assert env.pub_key().verify_signature(env.sign_bytes(), env.signature)
+    # re-encode is byte-identical (deterministic field order)
+    assert encode_envelope(env) == tx
+    # bare txs pass through as None
+    assert decode_envelope(b"k=v") is None
+
+
+def test_envelope_malformed_raises():
+    k = Ed25519PrivKey.generate()
+    tx = make_signed_tx(k, 0, b"p")
+    with pytest.raises(ValueError):
+        decode_envelope(tx[:10])  # truncated body
+    with pytest.raises(ValueError):
+        # prefix present, garbage body
+        decode_envelope(b"stx1" + b"\xff\xff\xff")
+    # missing signature field
+    env = TxEnvelope(k.TYPE, k.pub_key().bytes(), 0, b"p", b"")
+    with pytest.raises(ValueError):
+        decode_envelope(encode_envelope(env))
+
+
+# ---------------------------------------------------------------------------
+# admission pipeline basics
+
+
+class TestAdmission:
+    @pytest.mark.asyncio
+    async def test_bare_and_envelope_admission(self):
+        ing, pool = await make_ingress()
+        try:
+            await ing.submit_nowait(b"5:a")
+            k = Ed25519PrivKey.generate()
+            await ing.submit_nowait(make_signed_tx(k, 0, b"p0"))
+            assert pool.size() == 2
+            assert ing.stats["submitted"] == 2
+            assert pool.stats["admitted"] == 2
+            assert ing.occupancy == 0
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_bad_signature_rejected_before_checktx(self):
+        ing, pool = await make_ingress()
+        try:
+            k = Ed25519PrivKey.generate()
+            tx = make_signed_tx(k, 0, b"p0")
+            tx = tx[:-1] + bytes([tx[-1] ^ 1])
+            with pytest.raises(TxRejectedError):
+                await ing.submit_nowait(tx)
+            assert ing.stats["sig_failed"] == 1
+            assert pool.size() == 0  # never reached the ABCI round-trip
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_app_rejection_and_size_cap(self):
+        ing, pool = await make_ingress()
+        try:
+            with pytest.raises(TxRejectedError):
+                await ing.submit_nowait(b"1:bad")
+            with pytest.raises(TxRejectedError):
+                await ing.submit_nowait(b"1:" + b"x" * pool.config.max_tx_bytes)
+            assert pool.size() == 0
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_duplicate_dedup_before_any_work(self):
+        ing, pool = await make_ingress()
+        try:
+            await ing.submit_nowait(b"5:a")
+            with pytest.raises(TxInCacheError):
+                await ing.submit_nowait(b"5:a")
+            assert ing.stats["dedup_drops"] == 1
+            # concurrent duplicate: second joins while first in pipeline
+            f1 = ing.submit_nowait(b"6:b", source="peer1")
+            f2 = ing.submit_nowait(b"6:b", source="peer2")
+            await f1
+            with pytest.raises(TxInCacheError):
+                await f2
+            # the extra gossip source was recorded on the admitted tx:
+            # gossip will never echo the tx back to either peer
+            import tendermint_tpu.crypto.hashes as hashes
+
+            wtx = pool._txs[hashes.sha256(b"6:b")]
+            assert wtx.peers == {"peer1", "peer2"}
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_committed_tx_dedup_at_stage_zero(self):
+        """A gossip echo of a committed tx is dropped at submit — before
+        it costs a pipeline slot or a signature verify — even when the
+        mempool tx cache has churned the entry out."""
+        pool = make_pool(cache_size=2)
+        ing, pool = await make_ingress(pool)
+        try:
+            await ing.submit_nowait(b"5:committed")
+            async with pool.lock():
+                await pool.update(
+                    2, [b"5:committed"], [abci.ResponseDeliverTx()], recheck=False
+                )
+            # churn the LRU tx cache so only the committed LRU remembers
+            await ing.submit_nowait(b"1:churn-a")
+            await ing.submit_nowait(b"1:churn-b")
+            assert not pool.cache.has(b"5:committed")
+            before = ing.stats["submitted"]
+            with pytest.raises(TxInCacheError, match="committed"):
+                await ing.submit_nowait(b"5:committed")
+            assert ing.stats["submitted"] == before  # no slot consumed
+            assert ing.stats["dedup_drops"] >= 1
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_backpressure_sheds_never_buffers(self):
+        """A full pipeline rejects-with-busy synchronously; occupancy
+        stays bounded by depth (the never-unbounded-buffering edge)."""
+        pool = make_pool()
+        ing, pool = await make_ingress(pool, depth=4, verify_workers=1)
+        try:
+            # hold the releaser hostage: replace the pool's app client
+            # with one that parks until released
+            gate = asyncio.Event()
+            real = pool.app
+
+            class Gate:
+                async def check_tx(self, req):
+                    await gate.wait()
+                    return await real.check_tx(req)
+
+            pool.app = Gate()
+            futs = [ing.submit_nowait(b"1:tx%d" % i) for i in range(4)]
+            assert ing.occupancy == 4
+            with pytest.raises(IngressBusyError):
+                await ing.submit_nowait(b"1:overflow")
+            assert ing.stats["shed"] == 1
+            assert ing.occupancy == 4  # the shed tx took no slot
+            gate.set()
+            await asyncio.gather(*futs)
+            assert pool.size() == 4
+            # capacity released: the same-bytes tx is now a cache dup,
+            # a fresh one admits
+            await ing.submit_nowait(b"1:after")
+            assert pool.size() == 5
+        finally:
+            await ing.stop()
+
+
+# ---------------------------------------------------------------------------
+# nonce lanes
+
+
+class TestNonceLanes:
+    @pytest.mark.asyncio
+    async def test_out_of_order_parks_then_drains(self):
+        clock = ManualClock()
+        ing, pool = await make_ingress(clock=clock)
+        try:
+            k = Ed25519PrivKey.generate()
+            f2 = ing.submit_nowait(make_signed_tx(k, 2, b"p2"))
+            f1 = ing.submit_nowait(make_signed_tx(k, 1, b"p1"))
+            await asyncio.sleep(0.05)
+            # fresh lane: both park (nonce 0 never seen)
+            assert ing.parked_count() == 2
+            assert pool.size() == 0
+            f0 = ing.submit_nowait(make_signed_tx(k, 0, b"p0"))
+            await asyncio.gather(f0, f1, f2)
+            assert pool.size() == 3
+            # admitted in nonce order despite reversed arrival
+            order = [w.tx for w in sorted(pool._txs.values(), key=lambda w: w.seq)]
+            assert [decode_envelope(t).nonce for t in order] == [0, 1, 2]
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_stale_nonce_rejected(self):
+        ing, pool = await make_ingress()
+        try:
+            k = Ed25519PrivKey.generate()
+            await ing.submit_nowait(make_signed_tx(k, 0, b"p0"))
+            await ing.submit_nowait(make_signed_tx(k, 1, b"p1"))
+            with pytest.raises(TxRejectedError, match="stale nonce"):
+                await ing.submit_nowait(make_signed_tx(k, 0, b"again"))
+            assert ing.stats["stale_nonce"] == 1
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_duplicate_nonce_across_payloads_parks_once(self):
+        """Two different txs claiming the same (sender, nonce): the
+        first parks, the second is rejected as a dup of the parked slot;
+        after the gap fills only the first admits."""
+        ing, pool = await make_ingress()
+        try:
+            k = Ed25519PrivKey.generate()
+            f2a = ing.submit_nowait(make_signed_tx(k, 2, b"first"))
+            await asyncio.sleep(0.02)
+            with pytest.raises(TxRejectedError, match="already parked"):
+                await ing.submit_nowait(make_signed_tx(k, 2, b"second"))
+            await ing.submit_nowait(make_signed_tx(k, 0, b"p0"))
+            await ing.submit_nowait(make_signed_tx(k, 1, b"p1"))
+            await f2a
+            assert pool.size() == 3
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_lane_depth_bound(self):
+        ing, pool = await make_ingress(nonce_lane_depth=2)
+        try:
+            k = Ed25519PrivKey.generate()
+            ing.submit_nowait(make_signed_tx(k, 0, b"p0"))  # establishes lane
+            await asyncio.sleep(0.02)
+            ing.submit_nowait(make_signed_tx(k, 5, b"p5"))
+            ing.submit_nowait(make_signed_tx(k, 6, b"p6"))
+            await asyncio.sleep(0.05)
+            assert ing.parked_count() == 2
+            with pytest.raises(IngressBusyError, match="lane full"):
+                await ing.submit_nowait(make_signed_tx(k, 7, b"p7"))
+            assert ing.stats["lane_full"] == 1
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_global_park_capacity_bound(self):
+        """Fresh-sender floods must not sidestep the depth bound through
+        the parked set: total parked txs across ALL lanes is capped at
+        `depth` (shed busy beyond), so the ingress holds at most depth
+        in flight plus depth parked."""
+        ing, pool = await make_ingress(depth=3, nonce_lane_depth=8)
+        try:
+            futs = []
+            for i in range(3):  # 3 distinct senders, all gap-parked
+                k = Ed25519PrivKey(bytes([0x10 + i]) * 32)
+                futs.append(ing.submit_nowait(make_signed_tx(k, 5, b"gap")))
+            await asyncio.sleep(0.05)
+            assert ing.parked_count() == 3
+            k = Ed25519PrivKey(bytes([0x7F]) * 32)
+            with pytest.raises(IngressBusyError, match="park capacity"):
+                await ing.submit_nowait(make_signed_tx(k, 5, b"over"))
+            assert ing.stats["shed"] == 1
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_gap_park_expires_on_injected_clock(self):
+        clock = ManualClock()
+        ing, pool = await make_ingress(clock=clock, nonce_park_timeout_ms=1000.0)
+        try:
+            k = Ed25519PrivKey.generate()
+            await ing.submit_nowait(make_signed_tx(k, 0, b"p0"))
+            f5 = ing.submit_nowait(make_signed_tx(k, 5, b"p5"))
+            await asyncio.sleep(0.05)
+            assert ing.parked_count() == 1
+            # frozen clock: nothing expires no matter how long we wait
+            await asyncio.sleep(0.15)
+            assert ing.parked_count() == 1 and not f5.done()
+            clock.advance(2_000_000_000)  # 2s > 1s park timeout
+            await ing.submit_nowait(b"1:tick")  # release path runs expiry
+            with pytest.raises(TxRejectedError, match="gap timed out"):
+                await f5
+            assert ing.stats["park_expired"] == 1
+            # the lane watermark did NOT advance past the gap
+            with pytest.raises(TxRejectedError, match="stale nonce"):
+                await ing.submit_nowait(make_signed_tx(k, 0, b"re"))
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_fresh_lane_adopts_lowest_parked_on_timeout(self):
+        """A sender whose txs start above nonce 0 (or whose nonce-0 was
+        lost in transit): the lane parks, then adopts the lowest parked
+        nonce as its start when the park times out, instead of wedging
+        the sender forever."""
+        clock = ManualClock()
+        ing, pool = await make_ingress(clock=clock)
+        try:
+            k = Ed25519PrivKey.generate()
+            f5 = ing.submit_nowait(make_signed_tx(k, 5, b"p5"))
+            f6 = ing.submit_nowait(make_signed_tx(k, 6, b"p6"))
+            await asyncio.sleep(0.05)
+            assert ing.parked_count() == 2
+            clock.advance(5_000_000_000)
+            await ing.submit_nowait(b"1:tick")
+            await asyncio.gather(f5, f6)
+            assert pool.size() == 3
+            assert ing.stats["park_adopted"] == 1
+            # watermark adopted at 7 now
+            with pytest.raises(TxRejectedError, match="stale nonce"):
+                await ing.submit_nowait(make_signed_tx(k, 5, b"re"))
+        finally:
+            await ing.stop()
+
+
+# ---------------------------------------------------------------------------
+# pool satellites: eviction mid-flood, admission race, batched recheck
+
+
+class TestPoolUnderFlood:
+    @pytest.mark.asyncio
+    async def test_priority_eviction_under_full_pool_mid_flood(self):
+        pool = make_pool(size=8)
+        ing, pool = await make_ingress(pool)
+        try:
+            errs = 0
+            for i in range(100):
+                try:
+                    await ing.submit_nowait(b"%d:flood" % i)
+                except ValueError:
+                    errs += 1
+            assert pool.size() == 8
+            # the 8 highest-priority txs survived the flood
+            kept = sorted(int(w.tx.split(b":")[0]) for w in pool._txs.values())
+            assert kept == list(range(92, 100))
+            assert pool.stats["evicted"] == 92
+            assert pool.stats["admitted"] == 100
+            assert errs == 0  # eviction, not rejection, for ascending prio
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_admission_race_cannot_resurrect_committed_tx(self):
+        """The satellite race fix: a commit-time update() interleaving
+        with an in-flight CheckTx must not let the admission re-insert
+        the just-committed tx or corrupt _bytes accounting."""
+        gate = asyncio.Event()
+        reached = asyncio.Event()
+
+        class RaceApp(PrioApp):
+            async def slow(self, req):
+                reached.set()
+                await gate.wait()
+                return abci.ResponseCheckTx(priority=1, gas_wanted=1)
+
+        pool = make_pool()
+        real = pool.app
+
+        class GateClient:
+            def __init__(self):
+                self.app = RaceApp()
+
+            async def check_tx(self, req):
+                if req.tx == b"1:racer":
+                    return await self.app.slow(req)
+                return await real.check_tx(req)
+
+        pool.app = GateClient()
+        task = asyncio.get_running_loop().create_task(pool.check_tx(b"1:racer"))
+        await asyncio.wait_for(reached.wait(), 2.0)
+        # the block executor commits the same tx while CheckTx is in
+        # flight (it holds the pool lock across update, as execution.py
+        # does)
+        async with pool.lock():
+            await pool.update(2, [b"1:racer"], [abci.ResponseDeliverTx()], recheck=False)
+        gate.set()
+        with pytest.raises(TxInCacheError, match="committed during admission"):
+            await task
+        assert pool.size() == 0
+        assert pool.size_bytes() == 0  # no double-count from the race
+        # and a later resubmission is still a committed-cache rejection
+        with pytest.raises(TxInCacheError):
+            await pool.check_tx(b"1:racer")
+
+    @pytest.mark.asyncio
+    async def test_batched_recheck_matches_sequential_and_reprices(self):
+        """Post-commit recheck in concurrent slices: the surviving set
+        and the updated priorities are identical whatever the batch
+        width (1 == sequential semantics)."""
+        results = {}
+        for width in (1, 3, 64):
+            pool = make_pool(recheck_batch=width)
+            await pool.check_tx(b"5:keep")
+            await pool.check_tx(b"4:stale-soon")
+            await pool.check_tx(b"3:reprice-me")
+            await pool.check_tx(b"2:gone")
+            async with pool.lock():
+                await pool.update(2, [b"2:gone"], [abci.ResponseDeliverTx()])
+            results[width] = pool.reap_max_txs(-1)
+            assert pool.stats["recheck_failed"] == 1  # stale-soon dropped
+        # reprice-me jumped to priority 100 on recheck in every width
+        assert results[1] == results[3] == results[64]
+        assert results[1][0] == b"3:reprice-me"
+
+
+# ---------------------------------------------------------------------------
+# determinism: same-seed flood through a live (threaded) hub
+
+
+class TestDeterminism:
+    @pytest.mark.asyncio
+    async def test_same_seed_flood_bit_identical_admitted_order(self):
+        """The reorder buffer restores strict arrival order behind the
+        concurrent verify stage: two same-seed floods through a LIVE
+        VerifyHub (worker threads interleave nondeterministically)
+        admit byte-identical tx sequences."""
+
+        async def run_flood(seed: int) -> list[bytes]:
+            rng = random.Random(seed)
+            keys = [Ed25519PrivKey(bytes([i + 1]) * 32) for i in range(4)]
+            txs = []
+            for ci, k in enumerate(keys):
+                for nonce in range(6):
+                    txs.append(make_signed_tx(k, nonce, b"d-%d-%d" % (ci, nonce)))
+            txs += [b"%d:bare-%d" % (rng.randrange(9), i) for i in range(8)]
+            rng.shuffle(txs)
+            hub = vh.acquire_hub(max_batch=64, window_ms=1.0, cache_size=0)
+            try:
+                ing, pool = await make_ingress(verify_workers=4)
+                try:
+                    futs = [ing.submit_nowait(tx) for tx in txs]
+                    for f in futs:
+                        try:
+                            await f
+                        except ValueError:
+                            pass
+                    return [
+                        w.tx
+                        for w in sorted(pool._txs.values(), key=lambda w: w.seq)
+                    ]
+                finally:
+                    await ing.stop()
+            finally:
+                vh.release_hub()
+
+        a = await run_flood(1234)
+        b = await run_flood(1234)
+        assert a == b and len(a) > 0
+
+
+# ---------------------------------------------------------------------------
+# trace spans tile the admission path
+
+
+class TestTracing:
+    @pytest.mark.asyncio
+    async def test_ingress_spans_tile_admit_exactly(self):
+        old = trace.RECORDER.enabled
+        trace.RECORDER.enabled = True
+        trace.RECORDER.clear()
+        try:
+            ing, pool = await make_ingress()
+            try:
+                k = Ed25519PrivKey.generate()
+                await ing.submit_nowait(make_signed_tx(k, 0, b"traced"))
+            finally:
+                await ing.stop()
+        finally:
+            trace.RECORDER.enabled = old
+        spans = [
+            s
+            for s in trace.RECORDER.dump(subsystem="mempool.ingress")
+        ]
+        by_name = {s["name"]: s for s in spans}
+        stages = ["intake", "verify", "nonce_lane", "checktx", "insert"]
+        assert set(by_name) == set(stages) | {"admit"}
+        root = by_name["admit"]
+        assert root["attrs"]["outcome"] == "admitted"
+        # stages share boundaries: each starts where the previous ended
+        prev_end = root["start_s"]
+        for name in stages:
+            s = by_name[name]
+            assert s["trace_id"] == root["trace_id"]
+            assert abs(s["start_s"] - prev_end) < 2e-5
+            prev_end = s["start_s"] + s["duration_ms"] / 1e3
+        # ... and tile the root exactly
+        assert abs(prev_end - (root["start_s"] + root["duration_ms"] / 1e3)) < 2e-5
+        stage_sum = sum(by_name[n]["duration_ms"] for n in stages)
+        assert abs(stage_sum - root["duration_ms"]) < 2e-2  # ms
+
+
+# ---------------------------------------------------------------------------
+# event fan-out + RPC busy mapping + gossip fan-out
+
+
+class TestFanOut:
+    @pytest.mark.asyncio
+    async def test_drop_on_full_subscription_drops_with_counter(self):
+        from tendermint_tpu.libs import pubsub as ps
+
+        bus = PubSub()
+        base = ps.DROPPED["events"]
+        q = Query.parse("tm.event='Tx'")
+        slow = bus.subscribe("slow-ws", q, buffer=2, drop_on_full=True)
+        for i in range(5):
+            bus.publish({"i": i}, {"tm.event": ["Tx"]})
+        # two delivered, three dropped; the subscription survives
+        assert slow.dropped == 3
+        assert ps.DROPPED["events"] == base + 3
+        assert slow.cancelled is None
+        assert (await slow.next()).data == {"i": 0}
+        # the legacy contract still cancels laggards without the flag
+        fast = bus.subscribe("strict-ws", q, buffer=2)
+        for i in range(5):
+            bus.publish({"i": i}, {"tm.event": ["Tx"]})
+        assert fast.cancelled is not None
+
+    @pytest.mark.asyncio
+    async def test_rpc_broadcast_maps_busy(self):
+        from tendermint_tpu.rpc.core import MEMPOOL_BUSY_CODE, Environment
+
+        pool = make_pool()
+        ing, pool = await make_ingress(pool, depth=2, verify_workers=1)
+        try:
+            gate = asyncio.Event()
+            real = pool.app
+
+            class Gate:
+                async def check_tx(self, req):
+                    await gate.wait()
+                    return await real.check_tx(req)
+
+            pool.app = Gate()
+            env = Environment(chain_id="t", mempool=pool, ingress=ing)
+            asyncio.get_running_loop()  # (env handlers need a loop)
+            f1 = asyncio.get_running_loop().create_task(
+                env.broadcast_tx_sync(b"1:a".hex())
+            )
+            f2 = asyncio.get_running_loop().create_task(
+                env.broadcast_tx_sync(b"2:b".hex())
+            )
+            await asyncio.sleep(0.05)
+            busy = await env.broadcast_tx_sync(b"3:c".hex())
+            assert busy["code"] == MEMPOOL_BUSY_CODE
+            assert "busy" in busy["log"]
+            gate.set()
+            assert (await f1)["code"] == 0
+            assert (await f2)["code"] == 0
+            # async mode never errors, even shed (fire-and-forget)
+            res = await env.broadcast_tx_async(b"4:d".hex())
+            assert res["code"] == 0
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_gossip_never_echoes_to_source_and_caps_fanout(self):
+        from types import SimpleNamespace
+
+        from tendermint_tpu.mempool.reactor import MempoolReactor
+
+        pool = make_pool(gossip_fanout=2)
+        # the tx arrived from peerA: peers={peerA} at admission
+        await pool.check_tx(b"7:gossip", sender="peerA")
+        out_q: asyncio.Queue = asyncio.Queue(64)
+        reactor = MempoolReactor(
+            pool,
+            SimpleNamespace(out_q=out_q),
+            asyncio.Queue(4),
+        )
+        peers = ["peerA", "peerB", "peerC", "peerD"]
+        tasks = []
+        for p in peers:
+            reactor._sent[p] = set()
+            tasks.append(
+                asyncio.get_running_loop().create_task(reactor._broadcast_to(p))
+            )
+        await asyncio.sleep(0.2)
+        for t in tasks:
+            t.cancel()
+        sent_to = []
+        while not out_q.empty():
+            env = out_q.get_nowait()
+            sent_to.append(env.to)
+        # never echoed to its source …
+        assert "peerA" not in sent_to
+        # … and fan-out capped at 2 of the 3 eligible peers
+        assert len(sent_to) == 2
+        wtx = next(iter(pool._txs.values()))
+        assert wtx.gossiped == 2
+
+
+# ---------------------------------------------------------------------------
+# /metrics exposition
+
+
+class TestMetrics:
+    @pytest.mark.asyncio
+    async def test_flood_is_diagnosable_from_metrics_render(self):
+        from tendermint_tpu.libs.metrics import NodeMetrics
+
+        import gc
+
+        gc.collect()  # drop earlier tests' pools from the weak registry
+        ing, pool = await make_ingress()
+        try:
+            await ing.submit_nowait(b"5:m1")
+            with pytest.raises(TxRejectedError):
+                await ing.submit_nowait(b"1:bad")
+            text = NodeMetrics().render()
+        finally:
+            await ing.stop()
+        for needle in (
+            "tendermint_tpu_mempool_size 1",
+            "tendermint_tpu_mempool_bytes 4",
+            "tendermint_tpu_mempool_tx_admitted 1",
+            "tendermint_tpu_mempool_tx_rejected 1",
+            "tendermint_tpu_mempool_tx_shed 0",
+            "tendermint_tpu_ingress_submitted 2",
+            "tendermint_tpu_ingress_admit_latency_seconds_count 1",
+            "tendermint_tpu_pubsub_dropped_events",
+        ):
+            assert needle in text, needle
