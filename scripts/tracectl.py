@@ -10,6 +10,7 @@ accepted: ``{"spans": [...]}`` wrappers or a bare span list.
     curl -s localhost:26657/debug/traces | python scripts/tracectl.py -
     python scripts/tracectl.py dump.json --trace 42 # one trace, in order
     python scripts/tracectl.py dump.json --subsystem hub
+    python scripts/tracectl.py dump.json --per-device  # mesh shard table
 
 The per-stage table answers the ROADMAP question ("where did this vote
 spend its time?") in aggregate: count, p50, p90, p99, max, and total
@@ -79,6 +80,37 @@ def summarize(spans: list[dict]) -> str:
     return "\n".join(lines)
 
 
+def per_device(spans: list[dict]) -> str:
+    """Per-device shard-occupancy table from the hub.dispatch spans'
+    mesh attrs (devices=[ids], shards=[real-signature counts]): how
+    evenly the mesh is fed, straight from a flight dump."""
+    dispatches: dict = {}
+    sigs: dict = {}
+    total_sigs = 0
+    for s in spans:
+        if s.get("subsystem") != "hub" or s.get("name") != "dispatch":
+            continue
+        attrs = s.get("attrs") or {}
+        devices, shards = attrs.get("devices"), attrs.get("shards")
+        if not devices or shards is None:
+            continue
+        for dev, n in zip(devices, shards):
+            dispatches[dev] = dispatches.get(dev, 0) + 1
+            sigs[dev] = sigs.get(dev, 0) + int(n)
+            total_sigs += int(n)
+    if not dispatches:
+        return "no sharded hub.dispatch spans (single-device or CPU route)"
+    header = f"{'device':>8} {'dispatches':>11} {'sigs':>10} {'share':>7} {'sigs/dispatch':>14}"
+    lines = [header, "-" * len(header)]
+    for dev in sorted(dispatches):
+        n, total = dispatches[dev], sigs[dev]
+        share = total / total_sigs if total_sigs else 0.0
+        lines.append(
+            f"{dev!s:>8} {n:>11} {total:>10} {share:>6.1%} {total / n:>14.1f}"
+        )
+    return "\n".join(lines)
+
+
 def render_trace(spans: list[dict], trace_id: int) -> str:
     """One trace's spans in start order — a message's life, top down."""
     mine = [s for s in spans if s.get("trace_id") == trace_id]
@@ -103,6 +135,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("dump", help="dump file path, or - for stdin")
     ap.add_argument("--subsystem", help="only this subsystem's spans")
     ap.add_argument("--trace", type=int, help="print one trace in start order")
+    ap.add_argument(
+        "--per-device",
+        action="store_true",
+        help="per-device mesh shard occupancy from hub.dispatch spans",
+    )
     args = ap.parse_args(argv)
     try:
         spans = load_spans(args.dump)
@@ -113,6 +150,8 @@ def main(argv: list[str] | None = None) -> int:
         spans = [s for s in spans if s.get("subsystem") == args.subsystem]
     if args.trace is not None:
         print(render_trace(spans, args.trace))
+    elif args.per_device:
+        print(per_device(spans))
     else:
         print(summarize(spans))
     return 0

@@ -180,12 +180,19 @@ class VerifyHubConfig:
     """VerifyHub — the node-wide micro-batching signature-verification
     scheduler (crypto/verify_hub.py). Same knobs via TMTPU_VERIFYHUB_*
     env vars; TMTPU_VERIFYHUB_DISABLE=1 force-bypasses the hub even when
-    `enabled` is true."""
+    `enabled` is true. Mesh knobs ride the TMTPU_MESH_* env family:
+    TMTPU_MESH_SCALE=0 pins single-chip batch sizing, and the dispatch
+    layer reads TMTPU_MESH_MAX_DEVICES / TMTPU_MESH_BREAKER_RESET /
+    TMTPU_MESH_PROBE_TIMEOUT (crypto/tpu/mesh.py)."""
 
     enabled: bool = True
-    max_batch: int = 512  # dispatch as soon as this many sigs are queued
+    max_batch: int = 512  # per-chip dispatch target (sigs queued)
     window_ms: float = 2.0  # micro-batch window ceiling (adaptive below it)
     cache_size: int = 8192  # verified-(pubkey,msg,sig) LRU entries
+    # scale batch capacity + adaptive window by the ACTIVE device-mesh
+    # size, so an 8-chip mesh is fed 8× batches (and a degraded mesh
+    # shrinks them again); TMTPU_MESH_SCALE env overrides
+    mesh_scale: bool = True
 
 
 @dataclass

@@ -17,7 +17,16 @@ fallback transitions, and circuit-breaker state changes all land
 Metric families rendered from here: ``backend_attach_attempts``,
 ``backend_attach_latency_seconds`` (histogram),
 ``backend_compile_seconds{shape=}``, ``backend_active{kind=}``,
-``backend_fallbacks``, ``backend_breaker_transitions``.
+``backend_fallbacks``, ``backend_breaker_transitions``,
+``backend_compile_cache_hits``/``_misses``,
+``backend_mesh_devices{state=}``, ``backend_mesh_degrades``,
+``backend_shard_sigs{device=}``.
+
+Mesh telemetry (the MULTICHIP_r01–r05 blindness, fixed): device count at
+attach, per-device shard occupancy of every sharded dispatch, and every
+degrade/recover transition of the per-device breakers land here — an
+8-chip mesh losing a chip is a structured record with a flight dump, not
+an rc=124 timeout with no artifact.
 
 Writers: `crypto/batch.py` (probe — attach runs behind
 `libs/watchdog.BackendInitWatchdog` — warmup, breaker, fallback),
@@ -43,7 +52,32 @@ BACKEND: dict[str, float] = {
     "attach_failures": 0.0,   # attempts that raised or timed out
     "fallbacks": 0.0,         # TPU->CPU fallback EVENTS (per failed batch)
     "breaker_transitions": 0.0,  # breaker open/half-open/close events
+    "compile_cache_hits": 0.0,   # persistent-cache warm compiles (~0 ms)
+    "compile_cache_misses": 0.0,  # cold XLA compiles that hit the disk cache
 }
+
+#: a "compile" that finishes under this is a persistent-cache
+#: deserialize, not a compile: jax only persists compilations that took
+#: ≥ jax_persistent_cache_min_compile_time_secs (1.0 s, set in
+#: crypto/tpu/verify._ensure_compile_cache), so a warm-cache load of any
+#: cached kernel lands well under the same line
+COMPILE_CACHE_HIT_S = 1.0
+
+#: mesh state (multi-chip sharded dispatch): device counts + degrade
+#: transitions of the per-device breakers (crypto/tpu/mesh.py)
+MESH: dict[str, float] = {
+    "devices_total": 0.0,     # devices visible at attach
+    "devices_active": 0.0,    # devices currently in the dispatch mesh
+    "degrade_transitions": 0.0,  # mesh membership changes (either way)
+}
+
+#: device id -> signatures dispatched to that device's shard (real rows
+#: only, padding excluded) — the per-device occupancy record
+SHARD_SIGS: dict[str, float] = {}
+SHARD_DISPATCHES: dict[str, float] = {}
+
+#: shape bucket -> "hit"/"miss" of the last compile (persistent cache)
+COMPILE_CACHE: dict[str, str] = {}
 
 #: per-attempt latency observations (seconds) — rendered as the
 #: backend_attach_latency_seconds histogram; bounded so a flapping
@@ -86,11 +120,59 @@ def record_attach_attempt(
     )
 
 
-def record_compile(shape: str, seconds: float) -> None:
+def record_compile(shape: str, seconds: float, *, cache_hit: bool | None = None) -> None:
     """An XLA compile/warmup finished for one shape bucket (the floor
-    chunk, the blocksync max bucket, the fallback kernel, …)."""
+    chunk, the blocksync max bucket, the fallback kernel, …). Classifies
+    the persistent compile cache outcome: compile_ms ≈ 0 means the disk
+    cache answered (deserialize), anything slower was a cold compile —
+    the ROADMAP's 20–83 s warmup cliffs become countable."""
     COMPILE_SECONDS[shape] = seconds
-    trace.emit("backend", "compile", duration_s=seconds, shape=shape)
+    if cache_hit is None:
+        cache_hit = seconds < COMPILE_CACHE_HIT_S
+    COMPILE_CACHE[shape] = "hit" if cache_hit else "miss"
+    BACKEND["compile_cache_hits" if cache_hit else "compile_cache_misses"] += 1
+    trace.emit(
+        "backend", "compile", duration_s=seconds, shape=shape,
+        cache="hit" if cache_hit else "miss",
+    )
+
+
+def record_mesh(total: int, active: int) -> None:
+    """The device mesh attached (or was re-read): how many chips are
+    visible and how many are in the active dispatch set."""
+    MESH["devices_total"] = float(total)
+    MESH["devices_active"] = float(active)
+    trace.emit("backend", "mesh", devices_total=total, devices_active=active)
+    logger.info("device mesh: %d device(s), %d active", total, active)
+
+
+def record_degrade(from_n: int, to_n: int, reason: str) -> None:
+    """Mesh membership changed: a per-device breaker tripped (to_n <
+    from_n) or a recovery probe re-admitted a chip (to_n > from_n).
+    Each transition dumps the flight ring — degrades are rare and each
+    one is a hardware event worth its own artifact."""
+    MESH["degrade_transitions"] += 1
+    MESH["devices_active"] = float(to_n)
+    trace.emit(
+        "backend", "mesh_degrade",
+        from_devices=from_n, to_devices=to_n, reason=reason,
+    )
+    if to_n < from_n:
+        logger.warning(
+            "mesh degraded %d -> %d device(s): %s", from_n, to_n, reason
+        )
+        trace.auto_dump("mesh-degrade")
+    else:
+        logger.info("mesh recovered %d -> %d device(s)", from_n, to_n)
+
+
+def record_shard_dispatch(device_ids, shard_fill) -> None:
+    """One sharded dispatch landed: per-device real-signature counts
+    (padding rows excluded) keyed by device id."""
+    for dev_id, n in zip(device_ids, shard_fill):
+        key = str(dev_id)
+        SHARD_SIGS[key] = SHARD_SIGS.get(key, 0.0) + float(n)
+        SHARD_DISPATCHES[key] = SHARD_DISPATCHES.get(key, 0.0) + 1.0
 
 
 def record_fallback(from_kind: str, to_kind: str, reason: str) -> None:
@@ -128,7 +210,10 @@ def snapshot() -> dict:
         "attach_latency_s": [round(v, 3) for v in ATTACH_LATENCIES],
         "attach_latency_max_s": round(lat[-1], 3) if lat else 0.0,
         "compile_seconds": {k: round(v, 3) for k, v in COMPILE_SECONDS.items()},
+        "compile_cache": dict(COMPILE_CACHE),
         "active_kind": ACTIVE["kind"],
+        "mesh": {k: v for k, v in MESH.items()},
+        "shard_sigs": dict(SHARD_SIGS),
     }
 
 
@@ -136,6 +221,11 @@ def reset() -> None:
     """Test hook: clear all process-wide stores."""
     for k in BACKEND:
         BACKEND[k] = 0.0
+    for k in MESH:
+        MESH[k] = 0.0
     ATTACH_LATENCIES.clear()
     COMPILE_SECONDS.clear()
+    COMPILE_CACHE.clear()
+    SHARD_SIGS.clear()
+    SHARD_DISPATCHES.clear()
     ACTIVE["kind"] = "none"

@@ -117,6 +117,14 @@ def _probe_tpu() -> None:
 
                 platform = jax.devices()[0].platform
                 kind = "tpu" if platform not in ("cpu",) else "cpu"
+                # mesh telemetry: MULTICHIP_r01–r05 had 8 healthy chips
+                # the dispatch path never saw; record the topology the
+                # moment the attach succeeds, before any warmup can
+                # hang. active honors TMTPU_NO_SHARDED / MAX_DEVICES —
+                # the DISPATCH mesh, not the raw device count
+                from .tpu.verify import _shard_device_count
+
+                bt.record_mesh(len(jax.devices()), _shard_device_count())
             except Exception:  # noqa: BLE001 — kind is diagnostics only
                 kind = "unknown"
             bt.set_active(kind)
@@ -267,6 +275,23 @@ def tpu_breaker() -> CircuitBreaker:
     return _tpu_breaker
 
 
+def mesh_parallelism() -> int:
+    """Active device count sharded dispatch can use right now: 1 until
+    the backend probe completes, when sharding is disabled, or when only
+    one chip is healthy. The VerifyHub scales its micro-batch window and
+    capacity by this so an 8-chip mesh is fed 8-chip-sized batches —
+    and shrinks back automatically when per-device breakers degrade the
+    mesh. Cheap when no accelerator is up (no jax import)."""
+    if not _tpu_available:
+        return 1
+    try:
+        from .tpu.verify import _shard_device_count
+
+        return max(1, _shard_device_count())
+    except Exception:  # noqa: BLE001 — diagnostics must not break dispatch
+        return 1
+
+
 class AdaptiveBatchVerifier(BatchVerifier):
     """Collects entries, then routes the whole batch to the TPU kernel if
     it is large enough (and a backend is usable), else verifies on the
@@ -286,6 +311,10 @@ class AdaptiveBatchVerifier(BatchVerifier):
         #: per-instance, unlike the process-global LAST_ROUTE, so
         #: concurrent verifiers can't misattribute each other's batches
         self.last_route = "cpu"
+        #: {devices: [...], shards: [...]} when the last verify ran
+        #: sharded over the mesh (per-device real-signature counts);
+        #: None on single-device and host routes
+        self.last_dispatch = None
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if pub_key.TYPE not in _BATCHABLE:
@@ -332,8 +361,12 @@ class AdaptiveBatchVerifier(BatchVerifier):
                         bt.set_active("tpu")
                     _tpu_breaker.record_success()
                     LAST_ROUTE = self.last_route = "tpu"
+                    from .tpu.verify import last_dispatch_info
+
+                    self.last_dispatch = last_dispatch_info()
                     return out
         LAST_ROUTE = self.last_route = route
+        self.last_dispatch = None
         return self._run(CPUBatchVerifier())
 
     def _make_tpu_verifier(self) -> BatchVerifier:

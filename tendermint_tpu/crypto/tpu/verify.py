@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
 from functools import partial
 from math import gcd as _gcd
 
@@ -138,7 +139,11 @@ def _kernel_eq(ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx)
 
 _jitted_kernel = None
 _jitted_kernel_eq = None
-_sharded_kernels: dict[int, object] = {}
+#: device-id tuple -> (sharded eq kernel, sharded per-sig kernel). Keyed
+#: by the EXACT device set, not the count: after a per-device breaker
+#: trip the surviving mesh is a different set of chips and must not
+#: reuse a kernel pinned to the dead one.
+_sharded_kernels: dict[tuple, object] = {}
 _cache_ready = False
 
 
@@ -371,16 +376,16 @@ def warmup(
     # multi-device host a big bucket routes to the sharded kernels, and
     # warming the single-device jit would leave the real first batch to
     # compile inline anyway
-    kernel_eq, kernel_sig, b = _select_kernels(n, 1)
+    sel = _select_kernels(n, 1)
     # distinct dummy keys pin the unique-key count; they need not
     # decompress (shape is what compiles), but must be format-valid
     entries: list[ResolvedSig | None] = [
         ResolvedSig(i.to_bytes(4, "little") + b"\x00" * 28, b"\x01" + b"\x00" * 31, 0, 0)
         for i in range(g)
     ] + [None] * (n - g)
-    kernel_eq(*prepare_batch_eq(entries, pad_to=b))
+    sel.kernel_eq(*prepare_batch_eq(entries, pad_to=sel.bucket))
     if fallback:
-        kernel_sig(*prepare_resolved([None] * n, pad_to=b))
+        sel.kernel_sig(*prepare_resolved([None] * n, pad_to=sel.bucket))
 
 
 def make_sharded_kernel(mesh, axis: str = "data"):
@@ -398,6 +403,23 @@ def make_sharded_kernel(mesh, axis: str = "data"):
         in_shardings=(data, data, data, data, data),
         out_shardings=NamedSharding(mesh, P(axis)),
     )
+
+
+def _reduce_partials(partial_pts):
+    """Fold the per-device partial points into one. The device count is
+    static at trace time and tiny (≤ the mesh size), so a degraded
+    non-power-of-two mesh (8 → 7 after a breaker trip) folds with an
+    unrolled chain of point_adds instead of the power-of-two tree."""
+    from . import curve, msm
+    from .curve import Point
+
+    n_dev = partial_pts.x.shape[0]
+    if n_dev & (n_dev - 1) == 0:
+        return msm._tree_reduce_points(partial_pts, axis=0)
+    total = Point(*(c[0] for c in partial_pts))
+    for k in range(1, n_dev):
+        total = curve.point_add(total, Point(*(c[k] for c in partial_pts)))
+    return total
 
 
 def make_sharded_kernel_eq(mesh, axis: str = "data"):
@@ -451,9 +473,7 @@ def make_sharded_kernel_eq(mesh, axis: str = "data"):
     def kernel(ua_bytes, r_bytes, ga_digits, r_digits, zs_digits, s_valid, gidx):
         r_use, parts = sharded(r_bytes, r_digits, s_valid)
         partial_pts = Point(*(parts[:, i] for i in range(4)))
-        total = msm._tree_reduce_points(  # n_dev is a power of two
-            partial_pts, axis=0
-        )
+        total = _reduce_partials(partial_pts)
         # replicated epilogue: unique-key decompression + grouped A MSM
         ua_bytes = ua_bytes.astype(jnp.int32)
         ga_digits = ga_digits.astype(jnp.int32)
@@ -525,12 +545,14 @@ def resolve(pub_key, msg: bytes, sig: bytes) -> ResolvedSig | None:
     return None
 
 
-def prepare_batch(items: list[tuple[bytes, bytes, bytes]]):
+def prepare_batch(items: list[tuple[bytes, bytes, bytes]], pad_to: int = 0):
     """Host-side prep for the per-signature kernel. items: (pubkey32,
-    msg, sig64) ed25519 triples. Returns numpy arrays
+    msg, sig64) ed25519 triples; pad_to pads to the bucket shape (inert
+    rows). Returns numpy arrays
     (a_bytes, r_bytes, s_digits, h_digits, s_valid)."""
     return prepare_resolved(
-        [resolve_ed25519(pub, msg, sig) for pub, msg, sig in items]
+        [resolve_ed25519(pub, msg, sig) for pub, msg, sig in items],
+        pad_to=pad_to,
     )
 
 
@@ -635,35 +657,38 @@ def prepare_batch_eq(entries: list[ResolvedSig | None], pad_to: int = 0):
     )
 
 
-def _shard_device_count() -> int:
-    """How many local devices the sharded kernels may span: the largest
-    power-of-two prefix of jax.devices() (the partial-point tree reduction
-    and bucket padding both want a power of two; real TPU topologies are).
-    TMTPU_NO_SHARDED=1 pins the single-device path."""
+def _shard_devices() -> list:
+    """The devices the sharded kernels may span right now: the mesh
+    health registry's active set (per-device breakers, recovery probes —
+    crypto/tpu/mesh.py). TMTPU_NO_SHARDED=1 pins the single-device
+    path; TMTPU_MESH_MAX_DEVICES caps the mesh inside the registry."""
     if os.environ.get("TMTPU_NO_SHARDED"):
-        return 1
+        return []
     try:
-        import jax
+        from . import mesh as mesh_mod
 
-        n = len(jax.devices())
+        devs = mesh_mod.device_list()
     except Exception:  # noqa: BLE001 — backend not up yet
-        return 1
-    if n <= 1:
-        return 1
-    return n if n & (n - 1) == 0 else 1 << (n.bit_length() - 1)
+        return []
+    return devs if len(devs) > 1 else []
 
 
-def _get_sharded(n_dev: int):
+def _shard_device_count() -> int:
+    """Active mesh size (1 = single-device dispatch)."""
+    return max(1, len(_shard_devices()))
+
+
+def _get_sharded(devices: list):
     """(batch-equation kernel, per-signature fallback kernel) jitted over
-    an n_dev 1-D mesh; cached per device count."""
-    kernels = _sharded_kernels.get(n_dev)
+    a 1-D mesh of exactly `devices`; cached per device set."""
+    key = tuple(d.id for d in devices)
+    kernels = _sharded_kernels.get(key)
     if kernels is None:
-        import jax
         from jax.sharding import Mesh
 
-        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("data",))
+        mesh = Mesh(np.asarray(devices), ("data",))
         kernels = (make_sharded_kernel_eq(mesh), make_sharded_kernel(mesh))
-        _sharded_kernels[n_dev] = kernels
+        _sharded_kernels[key] = kernels
     return kernels
 
 
@@ -674,17 +699,77 @@ def _get_sharded(n_dev: int):
 _MAX_BUCKET = int(os.environ.get("TMTPU_MAX_BUCKET", "8192"))
 
 
-def _select_kernels(n: int, pad_multiple: int):
-    """(kernel_eq, kernel_sig, padded_bucket) for an n-entry chunk."""
-    n_dev = _shard_device_count()
+class _Selection:
+    """One dispatch plan: the kernels, the padded bucket shape, the pad
+    multiple it was bucketed with, and the device set (None = single)."""
+
+    __slots__ = ("kernel_eq", "kernel_sig", "bucket", "multiple", "devices")
+
+    def __init__(self, kernel_eq, kernel_sig, bucket, multiple, devices):
+        self.kernel_eq = kernel_eq
+        self.kernel_sig = kernel_sig
+        self.bucket = bucket
+        self.multiple = multiple
+        self.devices = devices
+
+
+def _select_kernels(n: int, pad_multiple: int) -> _Selection:
+    """Dispatch plan for an n-entry chunk: sharded over the active mesh
+    when the batch is big enough that every shard still fills a floor
+    bucket, single-device otherwise."""
+    devices = _shard_devices()
+    n_dev = len(devices)
     use_sharded = n_dev > 1 and (
         os.environ.get("TMTPU_FORCE_SHARDED") == "1" or n >= _MIN_BUCKET * n_dev
     )
     if use_sharded:
         mult = pad_multiple * n_dev // _gcd(pad_multiple, n_dev)
-        kernel_eq, kernel_sig = _get_sharded(n_dev)
-        return kernel_eq, kernel_sig, _bucket(n, mult)
-    return _get_kernel_eq(), _get_kernel(), _bucket(n, pad_multiple)
+        kernel_eq, kernel_sig = _get_sharded(devices)
+        return _Selection(kernel_eq, kernel_sig, _bucket(n, mult), mult, devices)
+    return _Selection(
+        _get_kernel_eq(), _get_kernel(), _bucket(n, pad_multiple),
+        pad_multiple, None,
+    )
+
+
+def _is_warm_bucket(m: int, multiple: int = 1) -> bool:
+    """True when `m` is a shape the bucket ladder can produce — some
+    power-of-two ≥ _MIN_BUCKET rounded up to `multiple`. Dispatch
+    asserts this on every chunk: any other shape would be an inline
+    cold XLA compile on the hot path (the ROADMAP's 20–83 s warmup
+    cliffs), which must instead route through pad-to-bucket or the CPU
+    fallback."""
+    if m < _MIN_BUCKET:
+        return False
+    multiple = max(1, multiple)
+    b = _MIN_BUCKET
+    while True:
+        rounded = ((b + multiple - 1) // multiple) * multiple
+        if rounded == m:
+            return True
+        if rounded > m:
+            return False
+        b *= 2
+
+
+def _shard_fill(n_real: int, bucket: int, n_dev: int) -> list[int]:
+    """Real (non-padding) signatures landing on each device's contiguous
+    shard of a `bucket`-row batch — the per-device occupancy record."""
+    s = bucket // n_dev
+    return [max(0, min(s, n_real - k * s)) for k in range(n_dev)]
+
+
+#: per-thread record of the last dispatch this thread ran: route-adjacent
+#: diagnostics for the VerifyHub's hub.dispatch spans (devices + shard
+#: fill). Thread-local for the same reason as AdaptiveBatchVerifier's
+#: last_route — concurrent verifiers must not misattribute each other.
+_dispatch_local = threading.local()
+
+
+def last_dispatch_info() -> dict | None:
+    """{devices: [...], shards: [...]} of this thread's last sharded
+    dispatch, or None when it ran single-device."""
+    return getattr(_dispatch_local, "info", None)
 
 
 def verify_resolved(
@@ -720,26 +805,93 @@ def _dispatch_and_collect(n: int, get_entries, pad_multiple: int) -> np.ndarray:
     full chunk size): stable shapes beat saving padding rows at the cost
     of an inline XLA compile of a one-off tail bucket. Bitmaps are only
     synced after every chunk is in flight; a failed equation falls back
-    to the per-signature kernel for that chunk alone."""
+    to the per-signature kernel for that chunk alone.
+
+    Mesh degradation: a sharded chunk that raises (a chip died mid-MSM)
+    hands the error to mesh.on_dispatch_failure, which probes every
+    device and trips the breakers of the dead ones. When membership
+    changed, the chunk re-dispatches recursively on the survivors (the
+    recursion re-selects kernels on the degraded mesh, bounded by the
+    device count); when no probe failed, the error re-raises and the
+    AdaptiveBatchVerifier's CPU fallback takes over — CPU only when the
+    mesh cannot make progress at all."""
     if n == 0:
         return np.zeros(0, bool)
-    kernel_eq, kernel_sig, b = _select_kernels(
-        _MAX_BUCKET if n > _MAX_BUCKET else n, pad_multiple
+    sel = _select_kernels(_MAX_BUCKET if n > _MAX_BUCKET else n, pad_multiple)
+    # hot-path shape discipline (see _is_warm_bucket): a non-bucket pad
+    # here would compile a cold one-off XLA shape inline
+    assert _is_warm_bucket(sel.bucket, sel.multiple), (
+        f"dispatch shape {sel.bucket} is not a bucket "
+        f"(multiple={sel.multiple}); pad-to-bucket or CPU fallback required"
     )
+    _dispatch_local.info = None
     in_flight = []
     for i in range(0, n, _MAX_BUCKET):
         chunk = get_entries(i, min(i + _MAX_BUCKET, n))
-        in_flight.append(
-            (chunk, kernel_eq(*prepare_batch_eq(chunk, pad_to=b)))
-        )
+        try:
+            res = sel.kernel_eq(*prepare_batch_eq(chunk, pad_to=sel.bucket))
+        except Exception as e:  # noqa: BLE001 — settled at collect time
+            res = e
+        in_flight.append((chunk, res))
     outs = []
-    for chunk, (bitmap, eq_ok) in in_flight:
-        if bool(eq_ok):
-            outs.append(np.asarray(bitmap)[: len(chunk)])
-        else:
-            out = np.asarray(kernel_sig(*prepare_resolved(chunk, pad_to=b)))
-            outs.append(out[: len(chunk)])
+    ids = [d.id for d in sel.devices] if sel.devices is not None else None
+    shards_total = [0] * len(ids) if ids else None
+    retried = False
+    for chunk, res in in_flight:
+        try:
+            if isinstance(res, Exception):
+                raise res
+            bitmap, eq_ok = res
+            if bool(eq_ok):
+                out = np.asarray(bitmap)[: len(chunk)]
+            else:
+                out = np.asarray(
+                    sel.kernel_sig(*prepare_resolved(chunk, pad_to=sel.bucket))
+                )[: len(chunk)]
+            if ids:
+                from .. import backend_telemetry as bt
+
+                fill = _shard_fill(len(chunk), sel.bucket, len(ids))
+                bt.record_shard_dispatch(ids, fill)
+                for k, m in enumerate(fill):
+                    shards_total[k] += m
+        except Exception as e:  # noqa: BLE001 — device failure mid-batch
+            out = _degrade_and_retry(chunk, pad_multiple, e, sel)
+            retried = True
+        outs.append(out)
+    if ids and not retried:
+        # a degrade retry stamped the surviving mesh's (smaller) info —
+        # keep that; only an all-healthy batch attributes to THIS mesh
+        _dispatch_local.info = {"devices": ids, "shards": shards_total}
     return outs[0] if len(outs) == 1 else np.concatenate(outs)
+
+
+def _degrade_and_retry(
+    chunk, pad_multiple: int, exc: Exception, sel: _Selection
+) -> np.ndarray:
+    """One chunk's dispatch raised. Single-device dispatch has nothing to
+    degrade to — re-raise (CPU fallback lives in crypto/batch.py). A
+    sharded dispatch consults the mesh registry: if probing attributed
+    the failure to specific chips, re-verify THIS chunk on the surviving
+    mesh (recursion re-selects kernels, so it lands on N−1 devices, then
+    N−2, … then the single-device kernel before the CPU path)."""
+    if sel.devices is None:
+        raise exc
+    from . import mesh as mesh_mod
+
+    if not mesh_mod.on_dispatch_failure(exc):
+        # an EARLIER chunk of this batch may already have tripped the
+        # dead chip's breaker (all chunks launch against the same
+        # selection before any is collected): retry whenever the active
+        # set no longer matches the one this selection was pinned to.
+        # Re-raise only when the mesh is genuinely unchanged — a
+        # transient/kernel error the CPU fallback should absorb.
+        current = [d.id for d in _shard_devices()]
+        if current == [d.id for d in sel.devices]:
+            raise exc
+    return _dispatch_and_collect(
+        len(chunk), lambda i, j: chunk[i:j], pad_multiple
+    )
 
 
 def verify_batch_eq(
@@ -772,17 +924,9 @@ def verify_batch(
     n = len(items)
     if n == 0:
         return np.zeros(0, bool)
-    a, r, sb, hb, sv = prepare_batch(items)
     b = _bucket(n, pad_multiple)
-    if b != n:
-        pad = b - n
-        a = np.pad(a, ((0, pad), (0, 0)))
-        r = np.pad(r, ((0, pad), (0, 0)))
-        sb = np.pad(sb, ((0, pad), (0, 0)))
-        hb = np.pad(hb, ((0, pad), (0, 0)))
-        sv = np.pad(sv, (0, pad))
     fn = kernel or _get_kernel()
-    out = np.asarray(fn(a, r, sb, hb, sv))
+    out = np.asarray(fn(*prepare_batch(items, pad_to=b)))
     return out[:n]
 
 

@@ -46,8 +46,18 @@ deliberately share one hub so cross-node duplicate votes dedup too.
 When no hub is running every helper falls back to direct host
 verification — unit tests and library users pay nothing.
 
+Mesh awareness: `max_batch` is a PER-CHIP target. Each dispatch
+iteration reads the active device-mesh size (crypto/batch
+`mesh_parallelism`, fed by the per-device breakers in
+crypto/tpu/mesh.py) and scales both the pack capacity and the adaptive
+window's ramp by it — an 8-chip mesh fills 8-chip-sized micro-batches,
+and a breaker-degraded mesh shrinks them the same iteration. Sharded
+dispatches stamp per-device shard occupancy onto their hub.dispatch
+spans (scripts/tracectl.py --per-device).
+
 Env knobs (override per-node config): TMTPU_VERIFYHUB_DISABLE=1,
-TMTPU_VERIFYHUB_BATCH, TMTPU_VERIFYHUB_WINDOW_MS, TMTPU_VERIFYHUB_CACHE.
+TMTPU_VERIFYHUB_BATCH, TMTPU_VERIFYHUB_WINDOW_MS, TMTPU_VERIFYHUB_CACHE,
+TMTPU_MESH_SCALE=0 (pin single-chip batch sizing).
 """
 
 from __future__ import annotations
@@ -134,6 +144,7 @@ class VerifyHub:
         window_ms: float | None = None,
         cache_size: int | None = None,
         adaptive: bool = True,
+        mesh_scale: bool | None = None,
         name: str = "verify-hub",
     ):
         # env wins over explicit kwargs (the node always passes its
@@ -157,11 +168,21 @@ class VerifyHub:
         cache_size = _knob(
             "TMTPU_VERIFYHUB_CACHE", cache_size, defaults.cache_size, int
         )
+        mesh_scale = _knob(
+            "TMTPU_MESH_SCALE",
+            mesh_scale,
+            defaults.mesh_scale,
+            lambda v: v.lower() not in ("0", "false", "no"),
+        )
         self.name = name
         self.max_batch = max(1, max_batch)
         self.window_s = max(0.0, window_ms) / 1e3
         self.cache_size = max(0, cache_size)
         self.adaptive = adaptive
+        #: scale batch capacity + window by the active mesh size: 8
+        #: chips fed single-chip-sized batches run at 1/8 occupancy
+        self.mesh_scale = bool(mesh_scale)
+        self._mesh_n = 1  # refreshed once per dispatch iteration
 
         self._cv = threading.Condition()
         # two FIFO lanes; dispatch packs live first, then backfill
@@ -412,6 +433,8 @@ class VerifyHub:
                 s["dispatched_sigs"] / s["dispatches"] if s["dispatches"] else 0.0
             )
             s["ewma_occupancy"] = self._ewma_occupancy
+            s["mesh_devices"] = float(self._mesh_n)
+            s["effective_max_batch"] = float(self._effective_max())
             uptime = max(time.monotonic() - self._started_at, 1e-9)
             s["dispatch_rate"] = s["dispatches"] / uptime
             requests = s["submitted"] + s["cache_hits"] + s["coalesced"]
@@ -419,6 +442,30 @@ class VerifyHub:
         return s
 
     # -- scheduling internals --------------------------------------------
+
+    def _refresh_mesh(self) -> int:
+        """Active device count, read once per dispatch iteration (the
+        mesh registry rate-limits its own recovery probes). Degrades to
+        1 on any error — a sick mesh must cost throughput, not dispatch."""
+        if self.mesh_scale:
+            from .batch import mesh_parallelism
+
+            try:
+                self._mesh_n = max(1, mesh_parallelism())
+            except Exception:  # noqa: BLE001 — diagnostics only
+                self._mesh_n = 1
+        else:
+            self._mesh_n = 1
+        return self._mesh_n
+
+    def _effective_max(self) -> int:
+        """Mesh-occupancy-aware batch capacity: one configured max_batch
+        PER ACTIVE DEVICE. An 8-chip mesh dispatching single-chip-sized
+        batches runs every chip at 1/8 shard occupancy; scaling the
+        pack target (and the window ramp below) keeps all chips fed —
+        and a per-device breaker degrading the mesh shrinks the target
+        the same dispatch loop iteration."""
+        return self.max_batch * self._mesh_n
 
     def _window(self) -> float:
         """Adaptive micro-batch window: scale the configured ceiling by
@@ -430,8 +477,9 @@ class VerifyHub:
         if occ <= 1.0:
             return 0.0
         # linear ramp: full window once recent batches average >= 1/8 of
-        # a device batch (past that, latency is already amortized)
-        frac = min(1.0, (occ - 1.0) / max(self.max_batch / 8.0, 1.0))
+        # a device batch (past that, latency is already amortized);
+        # device batch = per-chip max × active mesh size
+        frac = min(1.0, (occ - 1.0) / max(self._effective_max() / 8.0, 1.0))
         return self.window_s * frac
 
     def _queued(self) -> int:
@@ -440,6 +488,10 @@ class VerifyHub:
     def _dispatch_loop(self) -> None:
         self._worker_ids.add(threading.get_ident())
         while True:
+            # refresh the active mesh size OUTSIDE the lock: a degraded
+            # device's rate-limited recovery probe is bounded but slow,
+            # and submitters must keep filling the lanes meanwhile
+            self._refresh_mesh()
             with self._cv:
                 while self._running and not self._queued():
                     self._cv.wait(0.2)
@@ -448,8 +500,9 @@ class VerifyHub:
                         return
                     continue
                 # micro-batch window: linger for more arrivals unless the
-                # batch is device-sized, someone is blocked (urgent), or
-                # the hub is draining for shutdown
+                # batch is device-sized (mesh-scaled: one max_batch per
+                # active chip), someone is blocked (urgent), or the hub
+                # is draining for shutdown
                 if self._running:
                     oldest = min(
                         next(iter(q.values())).enqueued_at
@@ -460,7 +513,7 @@ class VerifyHub:
                     while (
                         self._running
                         and not self._urgent
-                        and self._queued() < self.max_batch
+                        and self._queued() < self._effective_max()
                     ):
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
@@ -508,12 +561,14 @@ class VerifyHub:
             fut.add_done_callback(lambda _f: self._slots.release())
 
     def _pack_batch(self) -> list[_Pending]:
-        """Pop up to max_batch entries, live lane FIRST — catch-up
-        traffic can never displace the hot path. Caller holds _cv."""
+        """Pop up to the mesh-scaled batch capacity, live lane FIRST —
+        catch-up traffic can never displace the hot path. Caller holds
+        _cv."""
+        cap = self._effective_max()
         batch: list[_Pending] = []
         for lane in LANES:
             q = self._queues[lane]
-            while q and len(batch) < self.max_batch:
+            while q and len(batch) < cap:
                 _, p = q.popitem(last=False)
                 self._inflight[p.key] = p
                 batch.append(p)
@@ -552,10 +607,18 @@ class VerifyHub:
             # batch.LAST_ROUTE can be overwritten by concurrent
             # verifiers elsewhere (the validation funnel builds its own)
             route = getattr(self._route_local, "route", "cpu")
+            disp = getattr(self._route_local, "dispatch", None)
             t1 = time.monotonic()
             trace.emit(
                 "hub", "dispatch",
                 duration_s=t1 - t0, sigs=len(batch), route=route,
+                # sharded dispatches carry per-device occupancy: device
+                # ids + real signatures per shard (tracectl --per-device)
+                **(
+                    {"devices": disp["devices"], "shards": disp["shards"]}
+                    if disp
+                    else {}
+                ),
             )
             for p in batch:
                 if p.traces:
@@ -590,6 +653,7 @@ class VerifyHub:
         # worker thread (concurrent _run_batch calls must not race), and
         # "cpu" on the host-side paths where no AdaptiveBatchVerifier runs
         self._route_local.route = "cpu"
+        self._route_local.dispatch = None
         if len(batchable) == 1:
             p = batch[batchable[0]]
             results[batchable[0]] = p.pub_key.verify_signature(p.msg, p.sig)
@@ -600,6 +664,7 @@ class VerifyHub:
                 bv.add(p.pub_key, p.msg, p.sig)
             _ok, bitmap = bv.verify()
             self._route_local.route = getattr(bv, "last_route", "cpu")
+            self._route_local.dispatch = getattr(bv, "last_dispatch", None)
             for i, good in zip(batchable, bitmap):
                 results[i] = bool(good)
         return results

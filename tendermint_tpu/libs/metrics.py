@@ -479,6 +479,25 @@ class NodeMetrics:
         self.backend_active = r.gauge(
             "backend", "active", "1 for the verifier kind currently routing batches"
         )
+        self.backend_compile_cache_hits = r.counter(
+            "backend", "compile_cache_hits",
+            "compiles answered by the persistent XLA cache (~0 ms deserialize)",
+        )
+        self.backend_compile_cache_misses = r.counter(
+            "backend", "compile_cache_misses", "cold XLA compiles"
+        )
+        self.backend_mesh_devices = r.gauge(
+            "backend", "mesh_devices",
+            "device mesh size (state=total at attach, state=active now)",
+        )
+        self.backend_mesh_degrades = r.counter(
+            "backend", "mesh_degrades",
+            "mesh membership transitions (per-device breaker trips + recoveries)",
+        )
+        self.backend_shard_sigs = r.counter(
+            "backend", "shard_sigs",
+            "signatures dispatched per device shard (padding excluded)",
+        )
         # abci
         self.abci_latency = r.histogram(
             "abci", "connection_latency_seconds", "app call latency"
@@ -617,11 +636,22 @@ class NodeMetrics:
         dst._count = 0
         for v in bt.ATTACH_LATENCIES:
             dst.observe(v)
+        self.backend_compile_cache_hits._values[()] = bt.BACKEND[
+            "compile_cache_hits"
+        ]
+        self.backend_compile_cache_misses._values[()] = bt.BACKEND[
+            "compile_cache_misses"
+        ]
         for shape, seconds in bt.COMPILE_SECONDS.items():
             self.backend_compile.set(round(seconds, 4), shape=shape)
         active = bt.ACTIVE["kind"]
         for kind in ("tpu", "cpu", "none"):
             self.backend_active.set(1.0 if kind == active else 0.0, kind=kind)
+        self.backend_mesh_devices.set(bt.MESH["devices_total"], state="total")
+        self.backend_mesh_devices.set(bt.MESH["devices_active"], state="active")
+        self.backend_mesh_degrades._values[()] = bt.MESH["degrade_transitions"]
+        for dev, sigs in bt.SHARD_SIGS.items():
+            self.backend_shard_sigs._values[(("device", dev),)] = sigs
 
     def render(self) -> str:
         # fold the process-wide resilience events in at scrape time

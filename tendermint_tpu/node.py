@@ -361,6 +361,7 @@ class Node(Service):
                 max_batch=self.config.verify_hub.max_batch,
                 window_ms=self.config.verify_hub.window_ms,
                 cache_size=self.config.verify_hub.cache_size,
+                mesh_scale=self.config.verify_hub.mesh_scale,
             )
         if self.config.watchdog_dir:
             from .libs.watchdog import LoopWatchdog

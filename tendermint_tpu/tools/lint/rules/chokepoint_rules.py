@@ -7,6 +7,14 @@ gossip-duplicate dedup. A new direct `*.verify_signature(...)` call
 site silently bypasses batching — the paper's headline metric (commit
 sigs verified/sec) regresses with no test failing.
 
+shape-bucketing: every host-prep call that feeds a verify kernel
+(`prepare_batch_eq` / `prepare_resolved` / `prepare_batch`) must pass
+``pad_to=`` — an unpadded call hands XLA the raw batch length as a
+static shape, and every new length is an inline cold compile on the hot
+path (the BENCH_r01–r05 rounds lost 20–83 s to exactly this class of
+stall). The dispatch core additionally asserts the padded shape is a
+bucket-ladder shape at runtime (crypto/tpu/verify._is_warm_bucket).
+
 fs-discipline: storage-layer writes go through the injectable
 `libs/chaosfs.FS`. The crash-consistency guarantees (torn-write /
 lost-fsync / ENOSPC recovery, tests/test_crash_recovery.py) only hold
@@ -159,4 +167,39 @@ class FsDiscipline(Rule):
         return "b" in m and any(c in m for c in "wax+")
 
 
-RULES = (VerifyChokepoint(), FsDiscipline())
+class ShapeBucketing(Rule):
+    id = "shape-bucketing"
+    doc = (
+        "kernel host-prep calls (prepare_batch_eq / prepare_resolved / "
+        "prepare_batch) must pass pad_to= — a raw batch length is a "
+        "cold XLA compile per distinct size on the hot path; route "
+        "through pad-to-bucket or the CPU fallback"
+    )
+    scope = ("tendermint_tpu/",)
+    profiles = ("node",)
+
+    PREP_CALLS = ("prepare_batch_eq", "prepare_resolved", "prepare_batch")
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = method_name(node) or call_name(node)
+            if name is None:
+                continue
+            short = name.rsplit(".", 1)[-1]
+            if short not in self.PREP_CALLS:
+                continue
+            if any(kw.arg == "pad_to" for kw in node.keywords):
+                continue
+            yield ctx.finding(
+                self.id,
+                node,
+                f"`{short}(...)` without pad_to= compiles a cold XLA "
+                "shape per distinct batch length on the hot path; pad "
+                "to a warmed bucket (crypto/tpu/verify._bucket) or take "
+                "the CPU fallback",
+            )
+
+
+RULES = (VerifyChokepoint(), FsDiscipline(), ShapeBucketing())

@@ -492,6 +492,47 @@ def test_crypto_backends_allowlisted():
 
 
 # ---------------------------------------------------------------------------
+# shape-bucketing
+
+
+def test_prep_without_pad_to_flagged():
+    """An unpadded kernel host-prep call hands XLA the raw batch length
+    as a static shape — a cold compile per distinct size on the hot
+    path. Both name-style and method-style calls are caught."""
+    src = """
+    from tendermint_tpu.crypto.tpu.verify import prepare_batch_eq
+
+    def dispatch(tpuv, entries):
+        a = prepare_batch_eq(entries)
+        b = tpuv.prepare_resolved(entries)
+        return a, b
+    """
+    fs = run(src, "shape-bucketing", rel="tendermint_tpu/crypto/tpu/somefile.py")
+    assert [f.line for f in fs] == [5, 6]
+
+
+def test_prep_with_pad_to_clean():
+    src = """
+    def dispatch(tpuv, entries, b):
+        ok = tpuv.prepare_batch_eq(entries, pad_to=b)
+        ok2 = tpuv.prepare_batch(entries, pad_to=b)
+        other = tpuv.prepare_dinner(entries)  # unrelated name
+        return ok, ok2, other
+    """
+    assert run(src, "shape-bucketing", rel=NODE_PATH) == []
+
+
+def test_prep_rule_relaxed_for_tests_profile():
+    """tests/ build ad-hoc shapes on purpose (compile cost is theirs to
+    pay); the rule only gates node code."""
+    src = """
+    def helper(tpuv, entries):
+        return tpuv.prepare_batch_eq(entries)
+    """
+    assert run(src, "shape-bucketing", rel="tests/test_something.py") == []
+
+
+# ---------------------------------------------------------------------------
 # fs-discipline
 
 
