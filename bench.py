@@ -1011,6 +1011,88 @@ def bench_tx_flood(n_clients: int = 10_000, txs_per_client: int = 2) -> dict:
     return asyncio.run(_bench_tx_flood_with_hub(n_clients, txs_per_client))
 
 
+def bench_commit_ab(n_vals: int = 150, n_commits: int = 2) -> dict:
+    """Aggregate-signature A/B (ISSUE 9 / arXiv:2302.00418): the SAME
+    chain shape — n_vals validators, n_commits full commits — measured
+    under both commit wire schemes:
+
+      eddsa_batch    — one ed25519 signature per validator, batch
+                       verified through the existing funnel;
+      bls_aggregate  — ONE 96-byte G2 aggregate per commit, pairing
+                       verified (BLS aggregation collapses gossip/
+                       storage bandwidth to O(1) signatures at the cost
+                       of pairing-heavy verification).
+
+    Records, per scheme: commit wire bytes, commit-verify sigs/s (the
+    live-consensus per-commit shape), and catch-up blocks/s (the
+    blocksync verify_commit_range shape). Verification memos (the
+    hash-to-curve LRU that signing pre-populated, the pure-ed25519
+    verdict memo) are cleared before every timed pass, so the numbers
+    are cold-verify rates, not cache reads. With TMTPU_BLS_TPU=1 and a
+    live backend the aggregate check routes through the batched pairing
+    kernel; otherwise the load-bearing pure-Python path is what is
+    being measured (recorded in `route`)."""
+    from tendermint_tpu import testing
+    from tendermint_tpu.crypto import bls_math
+    from tendermint_tpu.crypto import ed25519 as _ed
+    from tendermint_tpu.types import validation
+    from tendermint_tpu.types.block import aggregate_commit
+
+    chain_id = "ab-chain"
+    out: dict = {"n_vals": n_vals, "n_commits": n_commits}
+    for scheme, key_types in (
+        ("eddsa_batch", ("ed25519",)),
+        ("bls_aggregate", ("bls12381",)),
+    ):
+        log(f"commit_ab: building {n_vals}-val {scheme} commits …")
+        vals, by_addr = testing.make_validator_set(
+            n_vals, key_types=key_types, seed=b"ab-" + scheme.encode()
+        )
+        commits = []
+        for h in range(1, n_commits + 1):
+            bid = testing.make_block_id(b"ab%d" % h)
+            c = testing.make_commit(
+                chain_id, h, 0, bid, vals, by_addr,
+                timestamp_ns=1_700_000_000_000_000_000 + h,
+            )
+            if scheme == "bls_aggregate":
+                c = aggregate_commit(c, vals)
+            commits.append((vals, bid, h, c))
+        wire = len(commits[0][3].encode())
+        bls_math._H2_MEMO.clear()
+        _ed._VERIFY_MEMO.clear()
+        t0 = time.perf_counter()
+        for vs, bid, h, c in commits:
+            validation.verify_commit(chain_id, vs, bid, h, c)
+        dt = time.perf_counter() - t0
+        bls_math._H2_MEMO.clear()
+        _ed._VERIFY_MEMO.clear()
+        t0 = time.perf_counter()
+        validation.verify_commit_range(chain_id, commits)
+        dt_range = time.perf_counter() - t0
+        out[scheme] = {
+            "commit_wire_bytes": wire,
+            "sig_bytes_per_commit": 96 if scheme == "bls_aggregate" else 64 * n_vals,
+            "verify_sigs_per_s": round(n_vals * n_commits / dt, 1),
+            "verify_ms_per_commit": round(dt / n_commits * 1e3, 2),
+            "catchup_blocks_per_s": round(n_commits / dt_range, 3),
+        }
+        log(
+            f"commit_ab[{scheme}]: {wire} B/commit, "
+            f"{out[scheme]['verify_sigs_per_s']:,.0f} sigs/s, "
+            f"{out[scheme]['catchup_blocks_per_s']} catch-up blocks/s"
+        )
+    out["wire_ratio"] = round(
+        out["eddsa_batch"]["commit_wire_bytes"]
+        / out["bls_aggregate"]["commit_wire_bytes"],
+        2,
+    )
+    out["route"] = (
+        "pairing-kernel" if os.environ.get("TMTPU_BLS_TPU") == "1" else "pure-python"
+    )
+    return out
+
+
 def _multichip_measure(n_sigs: int, reps: int = 2) -> dict:
     """multichip config, in-process half: sharded vs single-device
     verification of the same batch on whatever mesh this process sees.
@@ -1348,6 +1430,18 @@ def main() -> None:
         extra["crash_recovery"] = bench_crash_recovery()
     except Exception as e:  # noqa: BLE001
         log(f"crash-recovery bench failed: {e!r}")
+    # commit_ab runs on BOTH backends: the aggregate-signature A/B —
+    # EdDSA-batch vs BLS-aggregate on the same 150-validator chain
+    # (commit wire bytes x verify sigs/s x catch-up blocks/s). On CPU
+    # images the pure-Python pairing dominates the BLS side; the wire
+    # numbers are backend-independent.
+    try:
+        ab_vals = int(os.environ.get("TMTPU_BENCH_AB_VALS", "150"))
+        extra["commit_ab"] = bench_commit_ab(
+            ab_vals, 4 if backend != "cpu" else 2
+        )
+    except Exception as e:  # noqa: BLE001
+        log(f"commit-ab bench failed: {e!r}")
     # multichip runs on BOTH backends, BOUNDED (the rc=124 probes were
     # the only multi-device signal for five rounds): sharded vs
     # single-device sigs/s + per-device shard occupancy, on the real

@@ -96,23 +96,30 @@ def _encode_events(first_field: int, events: tuple[Event, ...]) -> bytes:
 @dataclass(frozen=True)
 class ValidatorUpdate:
     """App-requested validator-set change (reference abci ValidatorUpdate):
-    power 0 removes the validator."""
+    power 0 removes the validator. bls12381 additions must carry `pop`
+    (proof of possession) — aggregate-commit soundness requires every
+    key in the set to have proven its secret, and validator updates are
+    the only post-genesis entry point (state/execution enforces it)."""
 
     pub_key_type: str
     pub_key: bytes
     power: int
+    pop: bytes = b""
 
     def encode(self) -> bytes:
-        return (
+        out = (
             pe.string_field(1, self.pub_key_type)
             + pe.bytes_field(2, self.pub_key)
             + pe.varint_field(3, self.power)
         )
+        if self.pop:
+            out += pe.bytes_field(4, self.pop)
+        return out
 
     @classmethod
     def decode(cls, data: bytes) -> "ValidatorUpdate":
         r = pe.Reader(data)
-        t, pk, power = "ed25519", b"", 0
+        t, pk, power, pop = "ed25519", b"", 0, b""
         while not r.eof():
             f, wt = r.read_tag()
             if f == 1:
@@ -121,9 +128,11 @@ class ValidatorUpdate:
                 pk = r.read_bytes()
             elif f == 3:
                 power = r.read_uvarint()
+            elif f == 4:
+                pop = r.read_bytes()
             else:
                 r.skip(wt)
-        return cls(t, pk, power)
+        return cls(t, pk, power, pop)
 
 
 @dataclass(frozen=True)

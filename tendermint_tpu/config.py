@@ -35,6 +35,14 @@ class ConsensusConfig:
     # TMTPU_INGEST_PIPELINE=0 disables, TMTPU_INGEST_INFLIGHT overrides.
     ingest_pipeline: bool = True
     ingest_max_inflight: int = 64
+    # commit wire scheme: "per-sig" stores one signature per validator
+    # (any key type, EdDSA-batch verified); "bls-aggregate" folds a
+    # BLS validator set's precommits into ONE 96-byte aggregate at
+    # commit materialization (O(1) signature bytes per commit, pairing
+    # verify). Aggregation silently falls back to per-sig when any
+    # participating signer is not bls12381 (mixed sets). Env mirror:
+    # TMTPU_COMMIT_SCHEME (wins over TOML).
+    commit_scheme: str = "per-sig"
 
     def propose_timeout_ns(self, round_: int) -> int:
         return self.timeout_propose_ns + self.timeout_propose_delta_ns * round_
@@ -73,6 +81,13 @@ class MempoolIngressConfig:
     # a nonce gap older than this (injected-clock wall domain) evicts
     # every tx parked behind it
     nonce_park_timeout_ms: float = 3000.0
+    # stage-B release slice width: consecutive in-release-order entries
+    # whose ABCI CheckTx calls are prefetched concurrently (the
+    # `_recheck` shape) before serial in-order admission consumes them.
+    # 1 (default) is byte-for-byte the serial semantics; >1 collapses
+    # the one-RTT-per-tx cost on remote-socket apps. Env mirror:
+    # TMTPU_INGRESS_CHECKTX_BATCH.
+    checktx_batch: int = 1
 
 
 @dataclass

@@ -55,10 +55,34 @@ def fast_config() -> ConsensusConfig:
     )
 
 
-def make_genesis(n_vals: int, chain_id: str = "test-chain") -> tuple[GenesisDoc, list]:
-    keys = det_priv_keys(n_vals)
+def make_genesis(
+    n_vals: int, chain_id: str = "test-chain", key_type: str = "ed25519"
+) -> tuple[GenesisDoc, list]:
+    if key_type == "ed25519":
+        keys = det_priv_keys(n_vals)
+    elif key_type == "bls12381":
+        import hashlib
+
+        from ..crypto.bls import BLSPrivKey
+
+        keys = [
+            BLSPrivKey(
+                hashlib.sha256(
+                    b"tmtpu-test" + key_type.encode() + i.to_bytes(4, "big")
+                ).digest()
+            )
+            for i in range(n_vals)
+        ]
+    else:
+        raise ValueError(f"unsupported harness key type {key_type}")
     gvals = [
-        GenesisValidator(k.pub_key(), 10, f"val{i}") for i, k in enumerate(keys)
+        GenesisValidator(
+            k.pub_key(),
+            10,
+            f"val{i}",
+            pop=k.pop_prove() if key_type == "bls12381" else b"",
+        )
+        for i, k in enumerate(keys)
     ]
     doc = GenesisDoc(
         chain_id=chain_id,
@@ -167,8 +191,9 @@ class LocalNetwork:
         chaos=None,
         base_clock=None,
         catchup: bool = True,
+        key_type: str = "ed25519",
     ):
-        self.genesis, self.keys = make_genesis(n_vals)
+        self.genesis, self.keys = make_genesis(n_vals, key_type=key_type)
         self.chaos = chaos
         self.catchup = catchup
         self.catchup_rescues = 0

@@ -92,8 +92,21 @@ class Handshaker:
 
         # 1. fresh chain → InitChain (reference replay.go:285 region)
         if app_height == 0 and state_height == 0:
+            # carry genesis proofs of possession into the InitChain
+            # updates: an app that echoes the request's validator set
+            # back must round-trip the PoPs, or the bls12381 rogue-key
+            # gate in validator_updates_to_validators would reject its
+            # own genesis set
+            pops = {
+                gv.pub_key.bytes(): gv.pop for gv in self.genesis_doc.validators
+            }
             validators = [
-                abci.ValidatorUpdate(v.pub_key.TYPE, v.pub_key.bytes(), v.voting_power)
+                abci.ValidatorUpdate(
+                    v.pub_key.TYPE,
+                    v.pub_key.bytes(),
+                    v.voting_power,
+                    pops.get(v.pub_key.bytes(), b""),
+                )
                 for v in state.validators.validators
             ]
             res = await app_conns.consensus.init_chain(

@@ -167,6 +167,8 @@ class ConsensusState(Service):
 
         self.rs = RoundState()
         self.state: State | None = None
+        # one-shot log guard for the aggregate-commit fallback path
+        self._warned_aggregate_fallback = False
 
         # one merged input queue for peer msgs and timer ticks — the
         # reference's select{} across three channels is pseudo-random among
@@ -762,7 +764,7 @@ class ConsensusState(Service):
             if height > self.state.initial_height:
                 last_commit = self.block_store.load_seen_commit(height - 1)
                 if last_commit is None and rs.last_commit is not None:
-                    last_commit = rs.last_commit.make_commit()
+                    last_commit = self._materialize_commit(rs.last_commit)
             try:
                 block, parts = self.block_exec.create_proposal_block(
                     height, self.state, last_commit, proposer_addr
@@ -1072,6 +1074,33 @@ class ConsensusState(Service):
     def _finalize_later(self) -> None:
         self._finalize_pending = True
 
+    def _materialize_commit(self, precommits):
+        """VoteSet -> Commit under the configured wire scheme
+        ([consensus] commit_scheme / TMTPU_COMMIT_SCHEME): with
+        "bls-aggregate", a BLS validator set's precommit signatures
+        fold into one 96-byte aggregate (pure data transformation of
+        the gossiped votes — deterministic, so same-seed chaos runs
+        produce byte-identical aggregate commits). Any participating
+        non-BLS signer falls back to the per-sig form, logged once."""
+        import os
+
+        from ..types.block import aggregate_commit
+
+        commit = precommits.make_commit()
+        scheme = os.environ.get("TMTPU_COMMIT_SCHEME") or self.config.commit_scheme
+        if scheme != "bls-aggregate":
+            return commit
+        try:
+            return aggregate_commit(commit, precommits.val_set)
+        except ValueError as e:
+            if not self._warned_aggregate_fallback:
+                self._warned_aggregate_fallback = True
+                self.logger.warning(
+                    "commit_scheme=bls-aggregate but commit kept per-sig "
+                    "form (%s)", e,
+                )
+            return commit
+
     async def _finalize_commit(self) -> None:
         """Reference finalizeCommit state.go:1611 — the only async step
         (ApplyBlock awaits the ABCI app)."""
@@ -1092,7 +1121,7 @@ class ConsensusState(Service):
         # around finalizeCommit (state.go:1647-1712)
         fail.fail_point(1)  # before saving the block
         if self.block_store.height() < height:
-            seen_commit = precommits.make_commit()
+            seen_commit = self._materialize_commit(precommits)
             self.block_store.save_block(block, parts, seen_commit)
         fail.fail_point(2)  # block saved, before the WAL end-height marker
         # height is durably decided: WAL end-height marker (the blockstore

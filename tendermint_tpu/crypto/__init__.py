@@ -75,18 +75,23 @@ def register_pubkey_type(type_name: str, decoder) -> None:
     _PUBKEY_DECODERS[type_name] = decoder
 
 
+#: builtin key-type modules, lazily imported on first decode
+_BUILTIN_KEY_MODULES = {
+    "ed25519": "ed25519",
+    "secp256k1": "secp256k1",
+    "sr25519": "sr25519",
+    "bls12381": "bls",
+}
+
+
 def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
-    if type_name not in _PUBKEY_DECODERS and type_name in (
-        "ed25519",
-        "secp256k1",
-        "sr25519",
-    ):
+    if type_name not in _PUBKEY_DECODERS and type_name in _BUILTIN_KEY_MODULES:
         # decoders register at module import; pull in the builtin module
         # for a known type on first use (a genesis doc with secp256k1
         # validators must decode without the caller pre-importing it)
         import importlib
 
-        importlib.import_module(f".{type_name}", __name__)
+        importlib.import_module(f".{_BUILTIN_KEY_MODULES[type_name]}", __name__)
     try:
         dec = _PUBKEY_DECODERS[type_name]
     except KeyError:
@@ -97,7 +102,8 @@ def pubkey_from_type_and_bytes(type_name: str, data: bytes) -> PubKey:
 # The reference's tendermint.crypto.PublicKey proto oneof field numbers
 # (proto/tendermint/crypto/keys.proto:13-17) — consensus-critical: the
 # validator-set hash merkles SimpleValidator encodings built on this.
-PUBKEY_PROTO_FIELD = {"ed25519": 1, "secp256k1": 2, "sr25519": 3}
+# bls12381 is a framework extension on the next free field number.
+PUBKEY_PROTO_FIELD = {"ed25519": 1, "secp256k1": 2, "sr25519": 3, "bls12381": 4}
 _PUBKEY_PROTO_TYPE = {v: k for k, v in PUBKEY_PROTO_FIELD.items()}
 
 

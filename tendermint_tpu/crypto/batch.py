@@ -15,10 +15,17 @@ import threading
 from ..libs.metrics import record_resilience
 from ..libs.retry import CircuitBreaker
 from . import BatchVerifier, PubKey
+from .bls import KEY_TYPE as BLS12381
 from .ed25519 import KEY_TYPE as ED25519
 from .sr25519 import KEY_TYPE as SR25519
 
-_BATCHABLE = (ED25519, SR25519)
+#: key types sharing the Edwards-curve MSM kernel (one TPU dispatch)
+_EDWARDS = (ED25519, SR25519)
+#: everything create_batch_verifier accepts; BLS batches through the
+#: pairing kernel / pure-Python path, NEVER the Edwards kernel — the
+#: AdaptiveBatchVerifier partitions by scheme so mixed validator sets
+#: still funnel through one verifier object
+_BATCHABLE = (ED25519, SR25519, BLS12381)
 
 logger = logging.getLogger("crypto.batch")
 
@@ -293,21 +300,26 @@ def mesh_parallelism() -> int:
 
 
 class AdaptiveBatchVerifier(BatchVerifier):
-    """Collects entries, then routes the whole batch to the TPU kernel if
-    it is large enough (and a backend is usable), else verifies on the
-    host. Small commits therefore never pay a device round-trip or a
-    first-call compile.
+    """Collects entries, PARTITIONS them by scheme (Edwards vs BLS — the
+    two never share a kernel dispatch), and routes each partition to its
+    device kernel when it is large enough (and a backend is usable),
+    else verifies on the host. Small commits therefore never pay a
+    device round-trip or a first-call compile.
 
-    Degradation: a TPU failure mid-batch (backend crash, kernel error)
-    re-verifies the SAME batch on the CPU path — the caller sees the
-    identical (ok, per-signature) result, never the error — trips the
-    TPU circuit breaker, and records the event in libs/metrics. While the
-    breaker is open all batches route to the host; its half-open probe
-    sends one batch back to the device to test recovery."""
+    Degradation: a device failure mid-batch (backend crash, kernel
+    error) re-verifies the SAME partition on the CPU path — the caller
+    sees the identical (ok, per-signature) result, never the error —
+    trips the shared TPU circuit breaker, and records the event in
+    libs/metrics. While the breaker is open all batches route to the
+    host; its half-open probe sends one batch back to the device to
+    test recovery. The BLS pairing kernel sits behind the SAME breaker:
+    a sick backend degrades both schemes at once, which is correct —
+    they share the device."""
 
     def __init__(self):
         self._items: list[tuple[PubKey, bytes, bytes]] = []
-        #: where the last verify() ran ("tpu"/"cpu"/"cpu-fallback") —
+        #: where the last verify() ran ("tpu"/"cpu"/"cpu-fallback", or
+        #: "mixed" when scheme partitions took different routes) —
         #: per-instance, unlike the process-global LAST_ROUTE, so
         #: concurrent verifiers can't misattribute each other's batches
         self.last_route = "cpu"
@@ -326,63 +338,201 @@ class AdaptiveBatchVerifier(BatchVerifier):
 
     def verify(self) -> tuple[bool, list[bool]]:
         global LAST_ROUTE
-        route = "cpu"
-        if len(self._items) >= MIN_TPU_BATCH and tpu_verifier_available():
-            probing = _tpu_breaker.state != "closed"  # read before allow() claims
-            if _tpu_breaker.allow():
-                from . import backend_telemetry as bt
+        items = self._items
+        results = [False] * len(items)
+        edwards = [i for i, it in enumerate(items) if it[0].TYPE in _EDWARDS]
+        bls = [i for i, it in enumerate(items) if it[0].TYPE == BLS12381]
+        routes = []
+        self.last_dispatch = None
+        if bls:
+            bres, broute = self._verify_bls([items[i] for i in bls])
+            for i, ok in zip(bls, bres):
+                results[i] = ok
+            routes.append(broute)
+        if edwards:
+            eres, eroute = self._verify_edwards([items[i] for i in edwards])
+            for i, ok in zip(edwards, eres):
+                results[i] = ok
+            routes.append(eroute)
+        if not routes:
+            route = "cpu"
+        elif len(set(routes)) == 1:
+            route = routes[0]
+        else:
+            route = "mixed"
+        LAST_ROUTE = self.last_route = route
+        return all(results) and bool(results), results
 
-                if probing:
-                    record_resilience("tpu_breaker_probes")
-                    bt.record_breaker("half-open")
-                    logger.info("TPU breaker half-open: probing the device path")
-                try:
-                    out = self._run(self._make_tpu_verifier())
-                except Exception as e:  # noqa: BLE001 — any device error degrades
-                    opens_before = _tpu_breaker.opens
-                    _tpu_breaker.record_failure()
-                    record_resilience("tpu_fallback_batches")
-                    record_resilience("tpu_fallback_sigs", len(self._items))
-                    if _tpu_breaker.opens > opens_before:
-                        record_resilience("tpu_breaker_opens")
-                        bt.record_breaker("open")
-                    bt.record_fallback("tpu", "cpu", repr(e))
-                    route = "cpu-fallback"
-                    logger.warning(
-                        "TPU batch verification failed (%r); re-verifying "
-                        "%d signatures on CPU (breaker %s)",
-                        e,
-                        len(self._items),
-                        _tpu_breaker.state,
-                    )
-                else:
-                    if probing:
-                        bt.record_breaker("closed")
-                        bt.set_active("tpu")
-                    _tpu_breaker.record_success()
-                    LAST_ROUTE = self.last_route = "tpu"
+    def _verify_edwards(self, items) -> tuple[list[bool], str]:
+        """The ed25519/sr25519 partition: shared-MSM TPU kernel when the
+        batch clears the measured cutoff, host loop otherwise."""
+        if len(items) >= MIN_TPU_BATCH and tpu_verifier_available():
+            out = self._device_guarded(
+                lambda: self._run(self._make_tpu_verifier(), items), len(items)
+            )
+            if out is not None:
+                if out is not _DEVICE_FAILED:
                     from .tpu.verify import last_dispatch_info
 
                     self.last_dispatch = last_dispatch_info()
-                    return out
-        LAST_ROUTE = self.last_route = route
-        self.last_dispatch = None
-        return self._run(CPUBatchVerifier())
+                    return out[1], "tpu"
+                return self._run(CPUBatchVerifier(), items)[1], "cpu-fallback"
+        return self._run(CPUBatchVerifier(), items)[1], "cpu"
+
+    def _verify_bls(self, items) -> tuple[list[bool], str]:
+        """The BLS partition: the batched pairing-product kernel when
+        the opt-in device path is enabled (TMTPU_BLS_TPU=1 — a cold
+        pairing compile is minutes-scale, so it never engages
+        implicitly), pure-Python verification otherwise. Same breaker,
+        same identical-result CPU re-verify on device failure."""
+        from .tpu import bls_pairing
+
+        if len(items) >= 2 and bls_pairing.device_enabled():
+            out = self._device_guarded(
+                lambda: (True, self._run_bls_kernel(items)), len(items)
+            )
+            if out is not None:
+                if out is not _DEVICE_FAILED:
+                    return out[1], "tpu"
+                return [
+                    pk.verify_signature(msg, sig) for pk, msg, sig in items
+                ], "cpu-fallback"
+        return [pk.verify_signature(msg, sig) for pk, msg, sig in items], "cpu"
+
+    def _run_bls_kernel(self, items) -> list[bool]:
+        """Host prep + batched pairing kernel: decode/subgroup-check
+        through the bls point caches; undecodable entries are False
+        without costing a kernel slot."""
+        from . import bls as bls_keys
+        from .tpu import bls_pairing
+
+        results = [False] * len(items)
+        triples = []
+        idxs = []
+        for i, (pk, msg, sig) in enumerate(items):
+            if len(sig) != bls_keys.SIGNATURE_SIZE:
+                continue
+            pt = bls_keys.pubkey_point(pk.bytes())
+            sp = bls_keys.signature_point(bytes(sig))
+            if pt is None or sp is None:
+                continue
+            triples.append((pt, msg, sp))
+            idxs.append(i)
+        if triples:
+            ok = bls_pairing.verify_items(triples)
+            for i, good in zip(idxs, ok):
+                results[i] = bool(good)
+        return results
+
+    def _device_guarded(self, run, n_sigs: int):
+        return _device_guarded(run, n_sigs)
 
     def _make_tpu_verifier(self) -> BatchVerifier:
         from .tpu.verify import TPUBatchVerifier
 
         return TPUBatchVerifier()
 
-    def _run(self, target: BatchVerifier) -> tuple[bool, list[bool]]:
-        for pk, msg, sig in self._items:
+    def _run(self, target: BatchVerifier, items=None) -> tuple[bool, list[bool]]:
+        for pk, msg, sig in items if items is not None else self._items:
             target.add(pk, msg, sig)
         return target.verify()
 
 
+#: sentinel distinguishing "device attempt failed (breaker tripped)"
+#: from "breaker already open" in _device_guarded
+_DEVICE_FAILED = object()
+
+
+def _device_guarded(run, n_sigs: int):
+    """Run a device attempt behind the shared TPU breaker. Returns the
+    run's result, _DEVICE_FAILED after a recorded device error (caller
+    re-verifies on CPU), or None when the open breaker kept us off the
+    device entirely."""
+    probing = _tpu_breaker.state != "closed"  # read before allow() claims
+    if not _tpu_breaker.allow():
+        return None
+    from . import backend_telemetry as bt
+
+    if probing:
+        record_resilience("tpu_breaker_probes")
+        bt.record_breaker("half-open")
+        logger.info("TPU breaker half-open: probing the device path")
+    try:
+        out = run()
+    except Exception as e:  # noqa: BLE001 — any device error degrades
+        opens_before = _tpu_breaker.opens
+        _tpu_breaker.record_failure()
+        record_resilience("tpu_fallback_batches")
+        record_resilience("tpu_fallback_sigs", n_sigs)
+        if _tpu_breaker.opens > opens_before:
+            record_resilience("tpu_breaker_opens")
+            bt.record_breaker("open")
+        bt.record_fallback("tpu", "cpu", repr(e))
+        logger.warning(
+            "device batch verification failed (%r); re-verifying "
+            "%d signatures on CPU (breaker %s)",
+            e,
+            n_sigs,
+            _tpu_breaker.state,
+        )
+        return _DEVICE_FAILED
+    if probing:
+        bt.record_breaker("closed")
+        bt.set_active("tpu")
+    _tpu_breaker.record_success()
+    return out
+
+
+def bls_aggregate_verify(pub_keys: list, msgs: list[bytes], agg_sig: bytes) -> bool:
+    """Aggregate-commit verification with device routing: the whole
+    check is ONE multi-pair pairing-product item, so it rides the BLS
+    kernel as a single dispatch when the opt-in device path is enabled
+    (same breaker / identical-result CPU fallback as batched verifies)
+    and the pure-Python path otherwise. Callers outside crypto/ go
+    through crypto/verify_hub.verify_aggregate (verdict cache)."""
+    from . import bls
+    from .tpu import bls_pairing
+
+    if bls_pairing.device_enabled():
+        from . import bls_math
+
+        agg = bls.signature_point(bytes(agg_sig)) if len(agg_sig) == bls.SIGNATURE_SIZE else None
+        pts = [bls.pubkey_point(pk.bytes()) if getattr(pk, "TYPE", None) == bls.KEY_TYPE else None for pk in pub_keys]
+        if agg is None or not pts or len(pts) != len(msgs) or any(p is None for p in pts):
+            # same reject surface AND same counters as the pure path —
+            # the bls_* metrics must not read zero on exactly the
+            # deployments that enable the kernel route
+            bls.STATS["aggregate_verifies"] += 1
+            bls.STATS["aggregate_signers"] += len(pub_keys)
+            bls.STATS["aggregate_failures"] += 1
+            return False
+        item = [(bls_math.NEG_G1_GEN, agg)] + [
+            (pt, bls_math.hash_to_point_g2(bytes(m))) for pt, m in zip(pts, msgs)
+        ]
+
+        def run():
+            return bls_pairing.verify_pairs_batch(
+                [item],
+                pad_to=bls_pairing.bucket_items(1),
+                pair_pad=bls_pairing.bucket_pairs(len(item)),
+            )
+
+        out = _device_guarded(run, len(pub_keys))
+        if out is not None and out is not _DEVICE_FAILED:
+            ok = bool(out[0])
+            bls.STATS["aggregate_verifies"] += 1
+            bls.STATS["aggregate_signers"] += len(pub_keys)
+            if not ok:
+                bls.STATS["aggregate_failures"] += 1
+            return ok
+    return bls.aggregate_verify(pub_keys, msgs, agg_sig)
+
+
 def supports_batch_verifier(pub_key: PubKey) -> bool:
-    """ed25519 and sr25519 batch (reference crypto/batch/batch.go:26 —
-    same two types); secp256k1 does not (falls back to single verify)."""
+    """ed25519 and sr25519 batch through the Edwards MSM kernel
+    (reference crypto/batch/batch.go:26 — same two types); bls12381
+    batches through the pairing kernel / pure-Python path. secp256k1
+    does not batch (falls back to single verify)."""
     return pub_key.TYPE in _BATCHABLE
 
 

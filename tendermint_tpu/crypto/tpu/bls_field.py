@@ -1,0 +1,387 @@
+"""BLS12-381 base-field and tower arithmetic on vectors of radix-2^8
+limbs, int32 — the 49-limb sibling of field.py's GF(2^255-19) kernels.
+
+Representation: an Fp element is an int32 array of shape (..., 49),
+limb i holding a (partially reduced) coefficient of 256^i, all limbs
+non-negative. The module invariant is limbs <= 526 ("weak-normal"):
+that bound keeps the MXU formulation of the product exact — the 49x49
+outer product has entries <= 526^2 < 2^18.1 (exact in float32) and the
+anti-diagonal contraction sums at most 49 of them, so every partial sum
+is an integer < 49 * 526^2 < 2^23.7 < 2^24 and float32 GEMM
+accumulation is bit-exact. (field.py's tighter <512 bound does not
+survive here: p's byte pattern has small limbs, so the subtraction
+offset — a multiple of p with every limb > 526 — needs the extra
+headroom. 49 limbs, not 48, because the reduction below needs the
+value headroom < 2^393 to converge in two small folds.)
+
+`mul` is the same GEMM-convolution shape as field.py:
+
+    outer = a (x) b                 (..., 49, 49)   - VPU elementwise
+    conv  = outer.reshape(..., 2401) @ S            - MXU GEMM, S 0/1
+
+but the modular fold differs: 2^392 mod p is a full-width constant, not
+ed25519's 38, so the high limbs cannot wrap with a scalar multiply.
+Instead the 97-limb convolution is carried to bytes (3 vectorized
+passes), the high 51 limbs are folded through a second small GEMM
+against F_HI (row i = the 49 byte limbs of 2^(8*(49+i)) mod p; partial
+sums <= 51*257*255 < 2^24, still exact f32), and two scalar folds of
+the residual limb 49 against M49 = bytes(2^392 mod p) finish:
+
+    conv <= 2^23.7 -> carry x3 -> bytes -> @F_HI -> <= 2^21.7
+         -> carry x3 -> limb49 <= 7 -> +limb49*M49 -> <= 2042
+         -> carry x2 -> limb49 <= 1 -> +limb49*M49 -> <= 511  (<= 526)
+
+The limb-49 bounds are value bounds, not per-pass bookkeeping: any
+non-negative limb vector whose value is < 2^393 has limb49 <= 1, which
+is what makes the final fold land under the invariant.
+
+The Fq2/Fq12 tower mirrors crypto/bls_math.py exactly: Fq2 = Fq[u]/
+(u^2+1) as (..., 2, 49), Fq12 FLAT as Fq2[w]/(w^6 - (1+u)) with shape
+(..., 6, 2, 49). Tower multiplies stack all their Fq cross-products
+into ONE mul() call (field.py's mul_many idiom), so an Fq12 multiply is
+4 GEMM dispatches, not 144. Both implementations are exact integer
+arithmetic mod p, so agreement with the pure-Python path is bit-for-bit
+(pinned in tests/test_bls.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..bls_math import P as P_INT, ZETA as ZETA_INT
+
+LIMBS = 49
+WIDE = 2 * LIMBS - 1  # 97-limb convolution output
+
+
+def int_to_limbs(v: int) -> np.ndarray:
+    """Python int -> canonical 49-limb vector (numpy, host prep)."""
+    return np.frombuffer(
+        int(v % P_INT).to_bytes(LIMBS, "little"), dtype=np.uint8
+    ).astype(np.int32)
+
+
+def limbs_to_int(a) -> int:
+    a = np.asarray(a, dtype=np.int64)
+    return sum(int(x) << (8 * i) for i, x in enumerate(a)) % P_INT
+
+
+ZERO = np.zeros(LIMBS, dtype=np.int32)
+ONE = int_to_limbs(1)
+
+# anti-diagonal routing matrix S[i*49+j, i+j] = 1 (field.py's shape)
+_S_CONV = np.zeros((LIMBS * LIMBS, WIDE), np.float32)
+for _i in range(LIMBS):
+    for _j in range(LIMBS):
+        _S_CONV[_i * LIMBS + _j, _i + _j] = 1.0
+
+# fold rows: F_HI[i] = byte limbs of 2^(8*(49+i)) mod p, i < 51. Row 0
+# doubles as M49 = 2^392 mod p (48 bytes; limb 48 is zero, which is what
+# makes the scalar-fold carry passes converge).
+_F_HI = np.stack(
+    [int_to_limbs(pow(2, 8 * (LIMBS + i), P_INT)) for i in range(51)]
+).astype(np.float32)
+M49 = _F_HI[0].astype(np.int32)
+assert M49[48] == 0
+# M48 = 2^384 mod p, used by canonical()'s byte-level folding
+M48 = int_to_limbs(pow(2, 8 * 48, P_INT))
+
+# subtraction offset: a multiple of p whose limbs all lie in [527, 782],
+# so OFFSET - b is non-negative limb-wise for any weak-normal b. Built
+# by the greedy digit construction: m*p - 527*U written in bytes, each
+# + 527 (U = (2^392-1)/255 = the all-ones limb vector's value).
+_U = (2**392 - 1) // 255
+_m = (527 * _U + P_INT - 1) // P_INT
+_W = _m * P_INT - 527 * _U
+assert 0 <= _W < 2**392
+OFFSET = (
+    np.frombuffer(_W.to_bytes(LIMBS, "little"), dtype=np.uint8).astype(np.int32)
+    + 527
+)
+assert OFFSET.min() >= 527 and OFFSET.max() <= 782
+
+# P - 2 bits (MSB first, leading 1 dropped) for Fermat inversion
+_PM2_BITS = np.array([int(b) for b in bin(P_INT - 2)[3:]], dtype=np.int32)
+
+# Frobenius^2 coefficients zeta^i as weak-normal limb rows (6, 49)
+_FROB2 = np.stack([int_to_limbs(pow(ZETA_INT, i, P_INT)) for i in range(6)])
+
+
+def _carry_pass(c: jnp.ndarray) -> jnp.ndarray:
+    """One plain carry pass over the last axis (no modular fold): keep
+    the low byte, push the high bits one limb up; the top limb's carry
+    is dropped, so callers must provide headroom."""
+    low = c & 0xFF
+    hi = c >> 8
+    hi_shift = jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+    )
+    return low + hi_shift
+
+
+def _carry_fold(c: jnp.ndarray) -> jnp.ndarray:
+    """One carry pass over a 49-limb vector with the 2^392 wrap: the
+    carry out of limb 48 folds back as carry * M49. M49 has no limb-48
+    component, so repeated passes converge."""
+    low = c & 0xFF
+    hi = c >> 8
+    top = hi[..., 48:49]
+    hi_shift = jnp.concatenate([jnp.zeros_like(top), hi[..., :48]], axis=-1)
+    return low + hi_shift + top * jnp.asarray(M49)
+
+
+def weak_reduce(c: jnp.ndarray, passes: int = 3) -> jnp.ndarray:
+    """Restore the weak-normal invariant (limbs <= 526). Three passes
+    suffice for any input with limbs < 2^13 (multi-term tower sums);
+    two for a plain a+b of weak-normal inputs."""
+    for _ in range(passes):
+        c = _carry_fold(c)
+    return c
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return weak_reduce(a + b, 2)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b mod p: the +OFFSET trick keeps limbs non-negative (OFFSET
+    is a multiple of p that limb-wise dominates any weak-normal b)."""
+    return weak_reduce(a + jnp.asarray(OFFSET) - b, 3)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return weak_reduce(jnp.asarray(OFFSET) - a, 3)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply (see module docstring for the bound analysis).
+    Inputs weak-normal; output limbs <= 511."""
+    a, b = jnp.broadcast_arrays(a, b)
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    outer = af[..., :, None] * bf[..., None, :]  # <= 526^2, exact f32
+    flat = outer.reshape(outer.shape[:-2] + (LIMBS * LIMBS,))
+    conv = jnp.matmul(
+        flat, jnp.asarray(_S_CONV), precision=jax.lax.Precision.HIGHEST
+    ).astype(jnp.int32)
+    # carry the 97-limb convolution to bytes (3 headroom limbs: the
+    # value is < 2^791.7 < 2^800)
+    c = jnp.pad(conv, [(0, 0)] * (conv.ndim - 1) + [(0, 3)])
+    c = _carry_pass(_carry_pass(_carry_pass(c)))
+    # GEMM-fold the high 51 byte limbs: partial sums <= 51*257*255 < 2^24
+    lo = c[..., :LIMBS]
+    hi = c[..., LIMBS:]
+    fold = jnp.matmul(
+        hi.astype(jnp.float32),
+        jnp.asarray(_F_HI),
+        precision=jax.lax.Precision.HIGHEST,
+    ).astype(jnp.int32)
+    c = lo + fold  # <= 2^21.7, value < 2^395
+    # carry to bytes again (2 headroom limbs), then two scalar folds of
+    # limb 49 (<= 7, then <= 1 — value bounds, see module docstring)
+    c = jnp.pad(c, [(0, 0)] * (c.ndim - 1) + [(0, 3)])
+    c = _carry_pass(_carry_pass(_carry_pass(c)))
+    c = c[..., :LIMBS] + c[..., LIMBS:LIMBS + 1] * jnp.asarray(M49)  # <= 2042
+    c = jnp.pad(c, [(0, 0)] * (c.ndim - 1) + [(0, 1)])
+    c = _carry_pass(_carry_pass(c))
+    return c[..., :LIMBS] + c[..., LIMBS:LIMBS + 1] * jnp.asarray(M49)  # <= 511
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def fp_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """a^(p-2) by square-and-multiply over the constant bit string
+    (lax.scan keeps the trace at one step)."""
+    bits = jnp.asarray(_PM2_BITS)
+
+    def step(acc, bit):
+        sq = mul(acc, acc)
+        withm = mul(sq, a)
+        return jnp.where(bit > 0, withm, sq), None
+
+    out, _ = lax.scan(step, a, bits)
+    return out
+
+
+def _scan_carry(c: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact sequential carry along the limb axis (field.py's shape).
+    Returns (byte limbs, carry out of limb 48)."""
+    c_t = jnp.moveaxis(c, -1, 0)
+
+    def step(carry, limb):
+        v = limb + carry
+        return v >> 8, v & 0xFF
+
+    carry_out, limbs = lax.scan(step, c_t[0] * 0, c_t)
+    return jnp.moveaxis(limbs, 0, -1), carry_out
+
+
+def canonical(a: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce to the canonical 48-byte-plus-zero representation in
+    [0, p) (limb 48 ends zero). Weak-normal input has value < 2^393;
+    two 2^392-folds bring it under 2^392 in exact bytes, four byte-level
+    2^384-folds bring it under 2^384 + p < 9.1p, and four conditional
+    subtractions of (8,4,2,1)p finish."""
+    v = a
+    for _ in range(2):
+        v, c = _scan_carry(v)
+        v = v + c[..., None] * jnp.asarray(M49)
+    v, c = _scan_carry(v)  # value < 2^392 now: c is 0
+    for _ in range(4):
+        v = jnp.concatenate(
+            [v[..., :48] + v[..., 48:49] * jnp.asarray(M48[:48]), v[..., 48:] * 0],
+            axis=-1,
+        )
+        v, _ = _scan_carry(v)
+    for k in (8, 4, 2, 1):
+        cmp_k = np.frombuffer(
+            (2**392 - k * P_INT).to_bytes(LIMBS, "little"), dtype=np.uint8
+        ).astype(np.int32)
+        w, carry = _scan_carry(v + jnp.asarray(cmp_k))
+        v = jnp.where((carry > 0)[..., None], w, v)
+    return v
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canonical(a) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(sub(a, b))
+
+
+# -- Fq2 = Fq[u]/(u^2+1): shape (..., 2, 49) ---------------------------------
+
+
+def fq2_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Schoolbook (4 Fq products, one stacked mul call):
+    (a0 + a1 u)(b0 + b1 u) = (a0b0 - a1b1) + (a0b1 + a1b0) u."""
+    a, b = jnp.broadcast_arrays(a, b)
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    m = mul(
+        jnp.stack([a0, a0, a1, a1], axis=-2),
+        jnp.stack([b0, b1, b0, b1], axis=-2),
+    )
+    c0 = sub(m[..., 0, :], m[..., 3, :])
+    c1 = weak_reduce(m[..., 1, :] + m[..., 2, :], 2)
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fq2_add(a, b):
+    return weak_reduce(a + b, 2)
+
+
+def fq2_sub(a, b):
+    return weak_reduce(a + jnp.asarray(OFFSET) - b, 3)
+
+
+def fq2_neg(a):
+    return weak_reduce(jnp.asarray(OFFSET) - a, 3)
+
+
+def fq2_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """(a0 - a1 u) / (a0^2 + a1^2) — one Fp inversion."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    n = add(mul(a0, a0), mul(a1, a1))
+    ninv = fp_inv(n)
+    return jnp.stack([mul(a0, ninv), mul(neg(a1), ninv)], axis=-2)
+
+
+def fq2_scale(a: jnp.ndarray, s: jnp.ndarray) -> jnp.ndarray:
+    """Multiply an Fq2 element (..., 2, 49) by an Fp scalar (..., 49)."""
+    return mul(a, s[..., None, :])
+
+
+# -- Fq12 flat: Fq2[w]/(w^6 - (1+u)), shape (..., 6, 2, 49) ------------------
+
+
+def f12_one(batch_shape: tuple = ()) -> jnp.ndarray:
+    one = np.zeros(batch_shape + (6, 2, LIMBS), np.int32)
+    one[..., 0, 0, :] = ONE
+    return jnp.asarray(one)
+
+
+def _mul_by_xi(c: jnp.ndarray) -> jnp.ndarray:
+    """(r + i u)(1 + u) = (r - i) + (r + i) u on (..., 2, 49)."""
+    r, i = c[..., 0, :], c[..., 1, :]
+    return jnp.stack([sub(r, i), weak_reduce(r + i, 2)], axis=-2)
+
+
+def f12_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Degree-6 polynomial product over Fq2 with the w^6 = xi fold —
+    all 36 Fq2 cross-products ride ONE stacked mul() (so one Fq12
+    multiply costs 4 GEMM dispatches + carries, not 144)."""
+    a, b = jnp.broadcast_arrays(a, b)
+    A = a[..., :, None, :, :]
+    B = b[..., None, :, :, :]
+    A, B = jnp.broadcast_arrays(A, B)
+    prod = fq2_mul(A, B)  # (..., 6, 6, 2, 49)
+    conv = []
+    for k in range(11):
+        terms = [
+            prod[..., i, k - i, :, :] for i in range(6) if 0 <= k - i < 6
+        ]
+        s = terms[0]
+        for t in terms[1:]:
+            s = s + t  # raw sums <= 6*526 < 2^12
+        conv.append(s)
+    out = []
+    for k in range(6):
+        lo = conv[k]
+        if k + 6 <= 10:
+            hi = weak_reduce(conv[k + 6], 3)
+            lo = lo + _mul_by_xi(hi)
+        out.append(weak_reduce(lo, 3))
+    return jnp.stack(out, axis=-3)
+
+
+def f12_conj(a: jnp.ndarray) -> jnp.ndarray:
+    """f^(p^6): negate the odd-w coefficients (eta = -1)."""
+    parts = []
+    for i in range(6):
+        c = a[..., i, :, :]
+        parts.append(fq2_neg(c) if i % 2 else c)
+    return jnp.stack(parts, axis=-3)
+
+
+def f12_frob2(a: jnp.ndarray) -> jnp.ndarray:
+    """f^(p^2): coefficient i scalar-multiplied by zeta^i (constants in
+    Fq) — all 12 Fp products in one mul call via broadcasting."""
+    return mul(a, jnp.asarray(_FROB2)[:, None, :])
+
+
+def f12_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Norm-based inversion (bls_math.f12_inv's shape): the product of
+    the five Frobenius^2 conjugates times a lands in Fq2."""
+    g = f12_frob2(a)
+    acc = g
+    for _ in range(4):
+        g = f12_frob2(g)
+        acc = f12_mul(acc, g)
+    n = f12_mul(a, acc)
+    ninv = fq2_inv(n[..., 0, :, :])
+    return fq2_mul(acc, ninv[..., None, :, :])
+
+
+def f12_canonical_ints(a) -> tuple:
+    """Device tensor -> the pure-Python 12-int tuple (host, tests)."""
+    c = np.asarray(canonical(jnp.asarray(a)))
+    out = []
+    for i in range(6):
+        out.append(limbs_to_int(c[..., i, 0, :]))
+        out.append(limbs_to_int(c[..., i, 1, :]))
+    return tuple(out)
+
+
+def f12_is_one(a: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise (over leading batch dims) comparison against 1."""
+    c = canonical(a)  # (..., 6, 2, 49)
+    one = f12_one(())
+    target = canonical(jnp.asarray(one))
+    return jnp.all(c == target, axis=(-3, -2, -1))

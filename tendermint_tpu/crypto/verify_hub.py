@@ -223,6 +223,11 @@ class VerifyHub:
             "lane_live_dispatched": 0.0,
             "lane_backfill_dispatched": 0.0,
             "lane_promotions": 0.0,  # backfill entries pulled into live
+            # per-scheme dispatch accounting (micro-batches partition by
+            # scheme: ed25519/sr25519 share the Edwards kernel, BLS runs
+            # the pairing path — rendered as verifyhub_scheme_sigs{scheme=})
+            "scheme_edwards_sigs": 0.0,
+            "scheme_bls_sigs": 0.0,
         }
 
     # -- lifecycle -------------------------------------------------------
@@ -410,6 +415,27 @@ class VerifyHub:
         with self._cv:
             self._urgent = True
             self._cv.notify_all()
+
+    # -- out-of-band verdict cache (aggregate commits) --------------------
+
+    def cached_verdict(self, key: tuple):
+        """Consult the verdict LRU for a non-triple key (the aggregate
+        commit path: one indivisible pairing-product check has nothing
+        to micro-batch, but gossip re-verifications still dedup)."""
+        with self._cv:
+            v = self._cache.get(key)
+            if v is not None:
+                self._cache.move_to_end(key)
+                self._stats["cache_hits"] += 1
+            return v
+
+    def store_verdict(self, key: tuple, ok: bool) -> None:
+        with self._cv:
+            if self.cache_size:
+                self._cache[key] = ok
+                self._cache.move_to_end(key)
+                while len(self._cache) > self.cache_size:
+                    self._cache.popitem(last=False)
 
     # -- introspection ---------------------------------------------------
 
@@ -638,15 +664,22 @@ class VerifyHub:
                     f.set_result(ok)
 
     def _verify_batch(self, batch: list[_Pending]) -> list[bool]:
-        """One batched verify per dispatch. Batchable key types
-        (ed25519/sr25519) share a single AdaptiveBatchVerifier — the
-        TPU/CPU routing, breaker, and identical-result fallback live
-        there; anything else verifies on the host individually."""
+        """One batched verify per scheme per dispatch. Batchable key
+        types are PARTITIONED by scheme — ed25519/sr25519 share the
+        Edwards MSM kernel, bls12381 runs the pairing kernel / pure
+        path — so a mixed-scheme micro-batch never packs both into one
+        kernel dispatch. Each partition gets its own
+        AdaptiveBatchVerifier (TPU/CPU routing, breaker, and
+        identical-result fallback live there); anything unbatchable
+        verifies on the host individually."""
         results = [False] * len(batch)
-        batchable: list[int] = []
+        # scheme partitions in deterministic order (dict preserves
+        # first-seen insertion; verdicts are order-independent anyway)
+        groups: dict[str, list[int]] = {}
         for i, p in enumerate(batch):
             if supports_batch_verifier(p.pub_key):
-                batchable.append(i)
+                scheme = "bls" if p.pub_key.TYPE == "bls12381" else "edwards"
+                groups.setdefault(scheme, []).append(i)
             else:
                 results[i] = p.pub_key.verify_signature(p.msg, p.sig)
         # where this batch ran, for the dispatch/execute spans: set per
@@ -654,18 +687,27 @@ class VerifyHub:
         # "cpu" on the host-side paths where no AdaptiveBatchVerifier runs
         self._route_local.route = "cpu"
         self._route_local.dispatch = None
-        if len(batchable) == 1:
-            p = batch[batchable[0]]
-            results[batchable[0]] = p.pub_key.verify_signature(p.msg, p.sig)
-        elif batchable:
-            bv = create_batch_verifier(batch[batchable[0]].pub_key)
-            for i in batchable:
+        if groups:
+            with self._cv:
+                for scheme, idxs in groups.items():
+                    self._stats[f"scheme_{scheme}_sigs"] += len(idxs)
+        for scheme, idxs in groups.items():
+            if len(idxs) == 1:
+                p = batch[idxs[0]]
+                results[idxs[0]] = p.pub_key.verify_signature(p.msg, p.sig)
+                continue
+            bv = create_batch_verifier(batch[idxs[0]].pub_key)
+            for i in idxs:
                 p = batch[i]
                 bv.add(p.pub_key, p.msg, p.sig)
             _ok, bitmap = bv.verify()
-            self._route_local.route = getattr(bv, "last_route", "cpu")
-            self._route_local.dispatch = getattr(bv, "last_dispatch", None)
-            for i, good in zip(batchable, bitmap):
+            route = getattr(bv, "last_route", "cpu")
+            if route != "cpu" or len(groups) == 1:
+                # prefer the device partition's tag on the span: a mixed
+                # dispatch that reached the device should read as such
+                self._route_local.route = route
+                self._route_local.dispatch = getattr(bv, "last_dispatch", None)
+            for i, good in zip(idxs, bitmap):
                 results[i] = bool(good)
         return results
 
@@ -735,6 +777,38 @@ async def averify_one(
     except Exception as e:  # noqa: BLE001 — timeout/shutdown races
         logger.warning("hub verify failed (%r); verifying inline", e)
         return pub_key.verify_signature(msg, sig)
+
+
+def verify_aggregate(pub_keys: list, msgs: list[bytes], agg_sig: bytes) -> bool:
+    """THE aggregate-commit chokepoint (types/validation routes every
+    aggregate `verify_commit*` here): one G2 aggregate signature
+    checked against per-signer messages via a single pairing product.
+    The check is indivisible — nothing to micro-batch — so it runs on
+    the caller's thread through crypto/batch.bls_aggregate_verify
+    (device routing + breaker + pure-Python fallback), but the running
+    hub's verdict LRU still answers gossip re-verifications of the
+    same commit without re-pairing."""
+    key = (
+        "bls-aggregate",
+        sha256(
+            b"".join(
+                len(x).to_bytes(4, "big") + x
+                for x in [pk.bytes() for pk in pub_keys] + [bytes(m) for m in msgs]
+            )
+        ),
+        bytes(agg_sig),
+    )
+    hub = running_hub()
+    if hub is not None:
+        hit = hub.cached_verdict(key)
+        if hit is not None:
+            return hit
+    from .batch import bls_aggregate_verify
+
+    ok = bls_aggregate_verify(pub_keys, msgs, agg_sig)
+    if hub is not None:
+        hub.store_verdict(key, ok)
+    return ok
 
 
 def verify_one(

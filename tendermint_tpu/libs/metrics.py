@@ -383,6 +383,32 @@ class NodeMetrics:
             "lane_promotions",
             "queued backfill entries pulled into the live lane by a live coalesce",
         )
+        # scheme-partitioned dispatch (ed25519/sr25519 share the Edwards
+        # kernel; bls12381 runs the pairing path — never one dispatch)
+        self.verifyhub_scheme_sigs = r.counter(
+            "verifyhub",
+            "scheme_sigs",
+            "signatures dispatched per signature scheme partition",
+        )
+        # BLS aggregate-commit path (crypto/bls.STATS, folded at render)
+        self.bls_verifies = r.counter(
+            "bls", "verifies", "single BLS signature verifications (memo misses)"
+        )
+        self.bls_verify_failures = r.counter(
+            "bls", "verify_failures", "failed single BLS verifications"
+        )
+        self.bls_aggregate_verifies = r.counter(
+            "bls", "aggregate_verifies", "aggregate-commit pairing-product checks"
+        )
+        self.bls_aggregate_failures = r.counter(
+            "bls", "aggregate_failures", "rejected aggregate-commit checks"
+        )
+        self.bls_aggregate_signers = r.counter(
+            "bls", "aggregate_signers", "signers covered by aggregate checks"
+        )
+        self.bls_pop_checks = r.counter(
+            "bls", "pop_checks", "proof-of-possession verifications (genesis)"
+        )
         # bucket layout shared with the hub's live histogram (one source
         # of truth — _fold_verify_hub copies counts index-for-index)
         from ..crypto.verify_hub import LATENCY_BUCKETS
@@ -526,6 +552,10 @@ class NodeMetrics:
             ]
             self.verifyhub_lane_queued.set(s[f"lane_{lane}_queued"], lane=lane)
         self.verifyhub_lane_promotions._values[()] = s["lane_promotions"]
+        for scheme in ("edwards", "bls"):
+            self.verifyhub_scheme_sigs._values[(("scheme", scheme),)] = s[
+                f"scheme_{scheme}_sigs"
+            ]
         # consistent snapshot taken under the hub lock (a mid-copy
         # dispatch would otherwise skew _count against the bucket sums)
         counts, sum_, count = hub.latency_snapshot()
@@ -667,7 +697,25 @@ class NodeMetrics:
         self._fold_mempool()
         self._fold_steps()
         self._fold_backend()
+        self._fold_bls()
         return self.registry.render()
+
+    def _fold_bls(self) -> None:
+        # only fold when the BLS module is already loaded: importing it
+        # at scrape time would pay the bls_math derivations on nodes
+        # that never touch a BLS key
+        import sys
+
+        bls = sys.modules.get("tendermint_tpu.crypto.bls")
+        if bls is None:
+            return
+        s = bls.STATS
+        self.bls_verifies._values[()] = s["verifies"]
+        self.bls_verify_failures._values[()] = s["verify_failures"]
+        self.bls_aggregate_verifies._values[()] = s["aggregate_verifies"]
+        self.bls_aggregate_failures._values[()] = s["aggregate_failures"]
+        self.bls_aggregate_signers._values[()] = s["aggregate_signers"]
+        self.bls_pop_checks._values[()] = s["pop_checks"]
 
 
 class _LastBlock:

@@ -220,7 +220,7 @@ def decode_envelope(tx: bytes) -> TxEnvelope | None:
 class _TxEntry:
     __slots__ = (
         "seq", "tx", "hash", "source", "fut", "ctx", "envelope", "error",
-        "t_submit", "t_pickup", "t_verified", "extra_sources",
+        "t_submit", "t_pickup", "t_verified", "extra_sources", "precheck",
     )
 
     def __init__(self, seq, tx, hash_, source, fut, ctx, t_submit):
@@ -236,6 +236,9 @@ class _TxEntry:
         self.t_pickup = 0.0
         self.t_verified = 0.0
         self.extra_sources: list[str] = []
+        # prefetched ABCI CheckTx response (stage-B slice micro-batch);
+        # consumed by PriorityMempool.check_tx in release order
+        self.precheck = None
 
 
 class _NonceLane:
@@ -279,6 +282,9 @@ class TxIngress(Service):
         self.park_timeout_ns = int(
             max(0.0, _knob("TMTPU_INGRESS_PARK_MS", config.nonce_park_timeout_ms, float))
             * 1e6
+        )
+        self.checktx_batch = max(
+            1, _knob("TMTPU_INGRESS_CHECKTX_BATCH", config.checktx_batch, int)
         )
         self.mempool = mempool
         self.clock = clock or SYSTEM
@@ -448,14 +454,80 @@ class TxIngress(Service):
     # -- stage B: in-order release → nonce lane → checktx/insert ---------
 
     async def _releaser(self) -> None:
+        """Single releaser: admissions happen strictly in release order.
+        With checktx_batch > 1, consecutive ready entries form a SLICE
+        whose ABCI CheckTx calls are prefetched concurrently (the
+        mempool `_recheck` shape) before the serial in-order admission
+        consumes them — the per-tx ABCI round-trip cost collapses to
+        one RTT per slice on remote-socket apps, while insert order,
+        nonce-lane semantics, and same-seed bit-reproducibility are
+        untouched (width 1 is byte-for-byte today's serial path,
+        asserted in tests)."""
         while True:
             while self._next_release not in self._reorder:
                 self._release_ev.clear()
                 await self._release_ev.wait()
-            entry = self._reorder.pop(self._next_release)
+            entries = [self._reorder.pop(self._next_release)]
             self._next_release += 1
-            await self._expire_parked()
-            await self._admit(entry)
+            while (
+                len(entries) < self.checktx_batch
+                and self._next_release in self._reorder
+            ):
+                entries.append(self._reorder.pop(self._next_release))
+                self._next_release += 1
+            if len(entries) > 1:
+                await self._prefetch_checktx(entries)
+            for entry in entries:
+                await self._expire_parked()
+                await self._admit(entry)
+
+    async def _prefetch_checktx(self, entries: list[_TxEntry]) -> None:
+        """Issue the slice's ABCI CheckTx calls concurrently and stash
+        the responses on the entries. Only entries the serial path will
+        plausibly admit prefetch: errored stage-A entries never reach
+        CheckTx, and `_would_skip_checktx` filters the doomed/parking
+        cases (stale nonce, out-of-order park, cache duplicate) so a
+        flood of rejects doesn't translate into wasted app round-trips
+        — the filter is a HEURISTIC (lane state can shift while the
+        slice admits); a wrong skip just means one inline RTT later,
+        never a wrong verdict. A prefetch failure likewise leaves
+        `precheck` unset and the serial path re-issues inline.
+        Staleness note: a commit landing mid-slice can make a
+        prefetched verdict stale, the exact window `_recheck` already
+        accepts; the committed-tx re-check under the pool lock still
+        prevents resurrection."""
+
+        async def fetch(entry: _TxEntry):
+            try:
+                entry.precheck = await self.mempool.precheck(entry.tx)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — serial path re-issues
+                entry.precheck = None
+
+        await asyncio.gather(
+            *(
+                fetch(e)
+                for e in entries
+                if e.error is None and not self._would_skip_checktx(e)
+            )
+        )
+
+    def _would_skip_checktx(self, entry: _TxEntry) -> bool:
+        """Best-effort predictor of 'this entry never reaches CheckTx in
+        serial admission': cache/committed duplicates reject at the
+        pool, nonce-laned entries that are stale or out-of-order reject
+        or park (and parked entries drop their prefetch anyway)."""
+        if self.mempool.is_committed(entry.tx) or self.mempool.cache.has(entry.tx):
+            return True
+        env = entry.envelope
+        if env is None:
+            return False
+        lane = self._lanes.get(env.sender)
+        nxt = lane.next if lane is not None else None
+        if nxt is None:
+            return env.nonce != 0  # fresh lane parks any nonzero nonce
+        return env.nonce != nxt  # stale (reject) or gap (park) alike
 
     async def _admit(self, entry: _TxEntry) -> None:
         if entry.error is not None:
@@ -527,6 +599,10 @@ class TxIngress(Service):
                     ),
                 )
                 return
+            # a parked entry admits at an arbitrarily later release:
+            # its slice-prefetched CheckTx verdict would be stale by
+            # whole blocks — drop it, the drain path re-issues
+            entry.precheck = None
             lane.parked[env.nonce] = (
                 entry, self.clock.now_ns() + self.park_timeout_ns
             )
@@ -567,9 +643,10 @@ class TxIngress(Service):
         )
         if entry.ctx is not None:
             entry.ctx.marks["checktx_start"] = t_lane_end
+        pre, entry.precheck = entry.precheck, None  # consume-once
         try:
             await self.mempool.check_tx(
-                entry.tx, sender=entry.source, trace_ctx=entry.ctx
+                entry.tx, sender=entry.source, trace_ctx=entry.ctx, pre=pre
             )
         except asyncio.CancelledError:
             raise

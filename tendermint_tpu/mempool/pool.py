@@ -141,8 +141,16 @@ class PriorityMempool(Mempool):
 
     # -- admission -------------------------------------------------------
 
+    async def precheck(self, tx: bytes):
+        """Bare ABCI CheckTx round-trip with NO cache/insert side
+        effects — the tx-ingress stage-B slice prefetch (release-order
+        micro-batching) issues these concurrently and hands the
+        responses back through check_tx(pre=...). Kept on the pool so
+        the app connection stays encapsulated."""
+        return await self.app.check_tx(abci.RequestCheckTx(tx))
+
     async def check_tx(
-        self, tx: bytes, sender: str = "", trace_ctx=None
+        self, tx: bytes, sender: str = "", trace_ctx=None, pre=None
     ) -> None:
         if len(tx) > self.config.max_tx_bytes:
             self.stats["rejected"] += 1
@@ -164,7 +172,11 @@ class PriorityMempool(Mempool):
             if trace_ctx is not None
             else 0.0
         )
-        res = await self.app.check_tx(abci.RequestCheckTx(tx))
+        # `pre` is a slice-prefetched response (ingress stage-B micro-
+        # batching): consume it instead of paying another ABCI RTT
+        res = pre if pre is not None else await self.app.check_tx(
+            abci.RequestCheckTx(tx)
+        )
         if trace_ctx is not None:
             t_ck1 = trace_ctx.clock.monotonic()
             trace.record(trace_ctx, "mempool.ingress", "checktx", t_ck0, t_ck1)

@@ -39,12 +39,33 @@ class VerifyChokepoint(Rule):
     id = "verify-chokepoint"
     doc = (
         "no direct *.verify_signature() outside the crypto/handshake/"
-        "harness allowlist — route through crypto/verify_hub; and no "
+        "harness allowlist — route through crypto/verify_hub; no "
         "sync-facade verification (verify_sync / submit_nowait().result())"
-        " inside coroutines in consensus/blocksync/statesync"
+        " inside coroutines in consensus/blocksync/statesync; and no "
+        "direct BLS pairing/aggregate-verify calls outside crypto/ — "
+        "route aggregate commits through verify_hub.verify_aggregate "
+        "(the pairing modules must not grow a second verify funnel)"
     )
     scope = ("tendermint_tpu/",)
     profiles = ("node",)
+
+    #: the BLS pairing/verify primitives (crypto/bls_math, crypto/bls,
+    #: crypto/tpu/bls_pairing, crypto/batch): calling one of these
+    #: outside crypto/ bypasses the hub's aggregate verdict cache and
+    #: the breaker-guarded device routing. PoP checks (pop_verify) are
+    #: construction-time, not the verify hot path, and stay legal.
+    BLS_FUNNEL_CALLS = frozenset(
+        {
+            "pairing",
+            "multi_pairing",
+            "miller_loop",
+            "final_exp",
+            "aggregate_verify",
+            "bls_aggregate_verify",
+            "verify_pairs_batch",
+            "verify_items",
+        }
+    )
 
     #: dirs where the pipelined ingest made the SYNC hub facade inside a
     #: coroutine a defect: it blocks the event loop on one signature and
@@ -73,6 +94,21 @@ class VerifyChokepoint(Rule):
                     "micro-batching and verdict dedup (the commit-sigs/sec "
                     "north star); route through crypto/verify_hub.verify_one "
                     "or the validation batch shim",
+                )
+                continue
+            name = method_name(node) or call_name(node)
+            if (
+                name is not None
+                and name.rsplit(".", 1)[-1] in self.BLS_FUNNEL_CALLS
+            ):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"direct BLS `{name.rsplit('.', 1)[-1]}()` outside "
+                    "crypto/ creates a second verify funnel — aggregate "
+                    "commits route through crypto/verify_hub."
+                    "verify_aggregate (verdict cache + breaker-guarded "
+                    "device routing)",
                 )
                 continue
             if not (in_async_scope and ctx.in_async_def(node)):
@@ -178,7 +214,12 @@ class ShapeBucketing(Rule):
     scope = ("tendermint_tpu/",)
     profiles = ("node",)
 
-    PREP_CALLS = ("prepare_batch_eq", "prepare_resolved", "prepare_batch")
+    PREP_CALLS = (
+        "prepare_batch_eq",
+        "prepare_resolved",
+        "prepare_batch",
+        "prepare_pairing_batch",
+    )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:
         for node in ast.walk(ctx.tree):

@@ -159,7 +159,12 @@ class CommitSig:
                 r.skip(wt)
         return cls(flag, addr, ts, sig)
 
-    def validate_basic(self) -> None:
+    def validate_basic(self, *, aggregate: bool = False) -> None:
+        """`aggregate=True` validates the entry as part of an aggregate
+        commit: the per-validator signature lives in the commit-level
+        aggregate, so it must be EMPTY here (flag/address/timestamp
+        rules are unchanged — they identify the signer and rebuild the
+        signed message)."""
         if self.flag not in (BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL):
             raise ValueError(f"unknown CommitSig flag {self.flag}")
         if self.is_absent():
@@ -168,7 +173,13 @@ class CommitSig:
         else:
             if len(self.validator_address) != 20:
                 raise ValueError("bad validator address size")
-            if not self.signature or len(self.signature) > 96:
+            if aggregate:
+                if self.signature:
+                    raise ValueError(
+                        "CommitSig inside an aggregate commit must not carry "
+                        "a per-validator signature"
+                    )
+            elif not self.signature or len(self.signature) > 96:
                 raise ValueError("bad signature size")
 
 
@@ -189,12 +200,27 @@ def _decode_timestamp(data: bytes) -> int:
 @dataclass(frozen=True)
 class Commit:
     """+2/3 precommits for a block (reference types/block.go Commit).
-    signatures[i] corresponds to validator i of the signing set."""
+    signatures[i] corresponds to validator i of the signing set.
+
+    Aggregate wire variant (the BLS commit path): `agg_sig` holds ONE
+    96-byte G2 aggregate of every non-absent precommit signature, and
+    the per-validator CommitSigs keep only flag/address/timestamp —
+    the flags ARE the signer bitmap (absent vs commit vs nil), the
+    timestamps rebuild each signer's distinct sign-bytes. A
+    150-validator commit shrinks from ~150 x 96 signature bytes to one,
+    at the cost of pairing-heavy verification (the arXiv:2302.00418
+    trade). Conversion is pure data transformation (`aggregate_commit`
+    below): BLS signatures aggregate publicly, so the proposer
+    aggregates the very sigs the validators gossiped."""
 
     height: int
     round: int
     block_id: BlockID
     signatures: tuple[CommitSig, ...]
+    agg_sig: bytes = b""
+
+    def is_aggregate(self) -> bool:
+        return bool(self.agg_sig)
 
     def vote_sign_bytes(self, chain_id: str, idx: int) -> bytes:
         """Rebuild the canonical sign-bytes of validator idx's precommit
@@ -211,7 +237,12 @@ class Commit:
         )
 
     def hash(self) -> bytes:
-        return merkle.hash_from_byte_slices([cs.encode() for cs in self.signatures])
+        leaves = [cs.encode() for cs in self.signatures]
+        if self.agg_sig:
+            # the aggregate is commit content: two commits differing
+            # only in agg_sig must hash differently
+            leaves.append(self.agg_sig)
+        return merkle.hash_from_byte_slices(leaves)
 
     def size(self) -> int:
         return len(self.signatures)
@@ -222,6 +253,8 @@ class Commit:
         out += pe.message_field(3, self.block_id.encode())
         for cs in self.signatures:
             out += pe.message_field(4, cs.encode())
+        if self.agg_sig:
+            out += pe.bytes_field(5, self.agg_sig)
         return out
 
     @classmethod
@@ -230,6 +263,7 @@ class Commit:
         height = round_ = 0
         block_id = NIL_BLOCK_ID
         sigs: list[CommitSig] = []
+        agg_sig = b""
         while not r.eof():
             f, wt = r.read_tag()
             if f == 1:
@@ -240,20 +274,69 @@ class Commit:
                 block_id = BlockID.decode(r.read_bytes())
             elif f == 4:
                 sigs.append(CommitSig.decode(r.read_bytes()))
+            elif f == 5:
+                agg_sig = r.read_bytes()
             else:
                 r.skip(wt)
-        return cls(height, round_, block_id, tuple(sigs))
+        return cls(height, round_, block_id, tuple(sigs), agg_sig)
 
     def validate_basic(self) -> None:
         if self.height < 0:
             raise ValueError("negative commit height")
+        if self.agg_sig and len(self.agg_sig) != 96:
+            raise ValueError("bad aggregate signature size")
         if self.height >= 1:
             if self.block_id.is_nil():
                 raise ValueError("commit cannot be for nil block")
             if not self.signatures:
                 raise ValueError("no signatures in commit")
+            aggregate = self.is_aggregate()
+            participating = 0
             for cs in self.signatures:
-                cs.validate_basic()
+                cs.validate_basic(aggregate=aggregate)
+                if not cs.is_absent():
+                    participating += 1
+            if aggregate and participating == 0:
+                raise ValueError("aggregate commit with no participating signers")
+
+
+def aggregate_commit(commit: Commit, vals) -> Commit:
+    """Convert a fully-signed commit into the aggregate wire variant:
+    every non-absent precommit signature (commit AND nil votes — both
+    are part of the attested history) folds into one G2 aggregate, and
+    the per-validator entries keep flag/address/timestamp only.
+
+    Pure data transformation — BLS signatures aggregate publicly, no
+    re-signing. Raises ValueError when any participating signer's key
+    is not BLS (mixed-scheme sets keep the per-sig wire form; the
+    caller falls back) or when the commit is unsigned. Deterministic:
+    the aggregate is a fixed-index-order point sum, so same votes in =>
+    byte-identical aggregate commit out (the chaos bit-reproducibility
+    surface)."""
+    from ..crypto import bls
+
+    if commit.is_aggregate():
+        return commit
+    sigs: list[bytes] = []
+    stripped: list[CommitSig] = []
+    for idx, cs in enumerate(commit.signatures):
+        if cs.is_absent():
+            stripped.append(cs)
+            continue
+        val = vals.get_by_index(idx)
+        if val is None or val.pub_key.TYPE != bls.KEY_TYPE:
+            raise ValueError(
+                f"cannot aggregate commit: validator {idx} is not bls12381"
+            )
+        sigs.append(cs.signature)
+        stripped.append(replace(cs, signature=b""))
+    if not sigs:
+        raise ValueError("cannot aggregate a commit with no signatures")
+    return replace(
+        commit,
+        signatures=tuple(stripped),
+        agg_sig=bls.aggregate_signatures(sigs),
+    )
 
 
 @dataclass(frozen=True)

@@ -19,6 +19,15 @@ Layout (field numbers):
   Timestamp: 1: seconds (varint)  2: nanos (varint)
 
 The result is length-prefixed (the signed message is the framed encoding).
+
+Aggregate commits (types/block.py) deliberately do NOT introduce a new
+canonical form: each signer of an aggregate commit signed exactly the
+per-validator `vote_sign_bytes` above (distinct timestamps => distinct
+messages), and the aggregate is a public point-sum of those signatures.
+Keeping the sign-bytes identical across both commit wire forms is what
+makes aggregation a pure data transformation — no re-signing, no HSM
+changes, and the per-sig and aggregate verification paths accept
+exactly the same signer statements.
 """
 
 from __future__ import annotations

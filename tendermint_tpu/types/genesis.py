@@ -16,6 +16,12 @@ class GenesisValidator:
     pub_key: crypto.PubKey
     power: int
     name: str = ""
+    # bls12381 validators MUST carry a proof of possession (a signature
+    # over the pubkey bytes under the POP domain tag): aggregate-commit
+    # positions are only sound against rogue-key attacks when every
+    # aggregated key proved knowledge of its secret. Checked at
+    # validator-set construction, not per verification.
+    pop: bytes = b""
 
 
 @dataclass
@@ -31,9 +37,31 @@ class GenesisDoc:
     def validator_set(self):
         from .validator_set import ValidatorSet
 
+        self._check_pops()
         return ValidatorSet(
             [Validator(gv.pub_key, gv.power) for gv in self.validators]
         )
+
+    def _check_pops(self) -> None:
+        """Rogue-key defense: every bls12381 genesis validator must
+        prove possession of its secret key before the set is
+        constructed — an unproven key in an aggregate position could be
+        a rogue-key combination of honest keys. (Per-validator verify
+        results are memoized in crypto/bls, so multi-node in-process
+        tests pay the pairing once per key.)"""
+        for gv in self.validators:
+            if gv.pub_key.TYPE != "bls12381":
+                continue
+            if not gv.pop:
+                raise ValueError(
+                    f"bls12381 genesis validator {gv.name or gv.pub_key!r} "
+                    "missing proof of possession"
+                )
+            if not gv.pub_key.pop_verify(gv.pop):
+                raise ValueError(
+                    f"bls12381 genesis validator {gv.name or gv.pub_key!r} "
+                    "has an invalid proof of possession"
+                )
 
     def validate_basic(self) -> None:
         if not self.chain_id or len(self.chain_id) > 50:
@@ -44,6 +72,7 @@ class GenesisDoc:
         for gv in self.validators:
             if gv.power <= 0:
                 raise ValueError("genesis validator with non-positive power")
+        self._check_pops()
 
     def to_json(self) -> str:
         return json.dumps(
@@ -73,6 +102,7 @@ class GenesisDoc:
                         "pub_key": gv.pub_key.bytes().hex(),
                         "power": gv.power,
                         "name": gv.name,
+                        **({"pop": gv.pop.hex()} if gv.pop else {}),
                     }
                     for gv in self.validators
                 ],
@@ -108,6 +138,7 @@ class GenesisDoc:
                     ),
                     v["power"],
                     v.get("name", ""),
+                    bytes.fromhex(v.get("pop", "")),
                 )
                 for v in d.get("validators", [])
             ],

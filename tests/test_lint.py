@@ -418,6 +418,54 @@ def test_sync_facade_flagged_in_mempool_and_rpc():
     assert len(run(src, "verify-chokepoint", rel="tendermint_tpu/rpc/core.py")) == 1
 
 
+def test_bls_funnel_calls_flagged_outside_crypto():
+    """The aggregate-commit path must not grow a second verify funnel:
+    direct pairing / aggregate-verify calls outside crypto/ bypass the
+    hub's verdict cache and the breaker-guarded device routing."""
+    src = """
+    def check_commit(self, pubs, msgs, agg):
+        if not bls.aggregate_verify(pubs, msgs, agg):
+            raise ValueError("bad aggregate")
+    def raw_pairing(self, p, q):
+        return bls_math.pairing(p, q)
+    def kernel_direct(self, items):
+        return bls_pairing.verify_pairs_batch(items, pad_to=4)
+    """
+    fs = run(src, "verify-chokepoint", rel="tendermint_tpu/types/validation.py")
+    assert len(fs) == 3
+    assert all("second verify funnel" in f.message for f in fs)
+    # blocksync is equally fenced
+    assert len(run(src, "verify-chokepoint", rel="tendermint_tpu/blocksync/pool.py")) == 3
+
+
+def test_bls_funnel_clean_cases():
+    # the hub chokepoint itself, PoP checks (construction-time), and
+    # aggregation (not verification) all stay legal outside crypto/
+    src = """
+    def check_commit(self, pubs, msgs, agg):
+        return verify_aggregate(pubs, msgs, agg)
+    def check_pop(self, gv):
+        return gv.pub_key.pop_verify(gv.pop)
+    def make_aggregate(self, sigs):
+        return bls.aggregate_signatures(sigs)
+    """
+    assert run(src, "verify-chokepoint", rel="tendermint_tpu/types/validation.py") == []
+    # inside crypto/ the primitives ARE the chokepoint (allowlisted)
+    direct = """
+    def verify(self, pubs, msgs, agg):
+        return bls_math.aggregate_verify(pubs, msgs, agg)
+    """
+    assert (
+        run(
+            direct,
+            "verify-chokepoint",
+            rel="tendermint_tpu/crypto/bls.py",
+            allowlist=Allowlist.load(DEFAULT_ALLOWLIST),
+        )
+        == []
+    )
+
+
 # ---------------------------------------------------------------------------
 # unbounded-queue
 
@@ -509,6 +557,22 @@ def test_prep_without_pad_to_flagged():
     """
     fs = run(src, "shape-bucketing", rel="tendermint_tpu/crypto/tpu/somefile.py")
     assert [f.line for f in fs] == [5, 6]
+
+
+def test_bls_pairing_prep_without_pad_to_flagged():
+    """The BLS pairing prep is shape-gated like the ed25519 preps: an
+    unpadded call cold-compiles a pairing kernel per batch length."""
+    src = """
+    def dispatch(items):
+        return prepare_pairing_batch(items, pair_pad=2)
+    """
+    fs = run(src, "shape-bucketing", rel="tendermint_tpu/crypto/tpu/bls_x.py")
+    assert len(fs) == 1 and "pad" in fs[0].message
+    padded = """
+    def dispatch(items, b):
+        return prepare_pairing_batch(items, pad_to=b, pair_pad=2)
+    """
+    assert run(padded, "shape-bucketing", rel="tendermint_tpu/crypto/tpu/bls_x.py") == []
 
 
 def test_prep_with_pad_to_clean():

@@ -472,6 +472,111 @@ class TestPoolUnderFlood:
 
 
 # ---------------------------------------------------------------------------
+# stage-B release slices (checktx_batch: the _recheck shape at admission)
+
+
+class TestStageBSlices:
+    @pytest.mark.asyncio
+    async def test_slice_widths_agree_with_serial(self):
+        """checktx_batch > 1 prefetches the slice's CheckTx calls
+        concurrently but admits strictly in release order: the admitted
+        set AND order are identical to width 1 (today's serial
+        semantics) for any width, including with rejections and
+        duplicates mixed in."""
+        results = {}
+        for width in (1, 4, 64):
+            ing, pool = await make_ingress(checktx_batch=width)
+            try:
+                assert ing.checktx_batch == width
+                k = Ed25519PrivKey(b"\x09" * 32)
+                txs = [b"5:a", b"3:bad-tx", b"7:c", b"5:a", b"2:d"]
+                txs += [make_signed_tx(k, n, b"e-%d" % n) for n in range(3)]
+                futs = [ing.submit_nowait(tx) for tx in txs]
+                outcomes = []
+                for f in futs:
+                    try:
+                        await f
+                        outcomes.append("ok")
+                    except ValueError as e:
+                        outcomes.append(type(e).__name__)
+                results[width] = (
+                    outcomes,
+                    [w.tx for w in sorted(pool._txs.values(), key=lambda w: w.seq)],
+                )
+            finally:
+                await ing.stop()
+        assert results[1] == results[4] == results[64]
+        outcomes, admitted = results[1]
+        assert outcomes.count("ok") == len(admitted) == 6
+        assert "TxRejectedError" in outcomes and "TxInCacheError" in outcomes
+
+    @pytest.mark.asyncio
+    async def test_parked_entry_drops_slice_prefetch(self):
+        """A nonce-gap park can admit whole blocks later: its
+        slice-prefetched CheckTx verdict must NOT be consumed at drain
+        time (stale by design) — the drain path re-issues."""
+        calls = []
+
+        class CountingApp(PrioApp):
+            def check_tx(self, req):
+                calls.append(bytes(req.tx))
+                return super().check_tx(req)
+
+        pool = PriorityMempool(MempoolConfig(), LocalClient(CountingApp()))
+        ing, pool = await make_ingress(pool=pool, checktx_batch=8)
+        try:
+            k = Ed25519PrivKey(b"\x0a" * 32)
+            gap = make_signed_tx(k, 1, b"later")
+            first = make_signed_tx(k, 0, b"first")
+            f_gap = ing.submit_nowait(gap)
+            f_first = ing.submit_nowait(first)
+            await f_first
+            await f_gap  # drained behind nonce 0
+            assert pool.size() == 2
+            # nonce 1 was prefetched in a slice, parked (prefetch
+            # dropped), then re-CheckTx'd at drain: if both entries rode
+            # one slice, `gap` appears twice in the app call log
+            assert calls.count(gap) >= 1 and calls.count(first) >= 1
+        finally:
+            await ing.stop()
+
+    @pytest.mark.asyncio
+    async def test_prefetch_failure_degrades_to_inline(self):
+        """A prefetch RTT failure leaves the entry without a stashed
+        verdict; the serial path re-issues inline and admission
+        proceeds — the prefetch is a latency optimization, never a
+        correctness gate."""
+        state = {"fail": True}
+
+        class FlakyApp(PrioApp):
+            def check_tx(self, req):
+                if state["fail"]:
+                    state["fail"] = False
+                    raise RuntimeError("transient app hiccup")
+                return super().check_tx(req)
+
+        pool = PriorityMempool(MempoolConfig(), LocalClient(FlakyApp()))
+        ing, pool = await make_ingress(pool=pool, checktx_batch=4)
+        try:
+            futs = [ing.submit_nowait(b"5:x"), ing.submit_nowait(b"6:y")]
+            outcomes = []
+            for f in futs:
+                try:
+                    await f
+                    outcomes.append("ok")
+                except ValueError:
+                    outcomes.append("rejected")
+            # at most one tx can have been hit by the single transient
+            # failure, and nothing wedged the releaser
+            assert outcomes.count("ok") >= 1
+            assert pool.size() == outcomes.count("ok")
+            await ing.submit_nowait(b"7:z")
+            assert pool.size() == outcomes.count("ok") + 1
+        finally:
+            await ing.stop()
+
+
+# ---------------------------------------------------------------------------
 # determinism: same-seed flood through a live (threaded) hub
 
 
