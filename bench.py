@@ -1247,6 +1247,229 @@ def bench_multichip(timeout_s: float = 600.0) -> dict:
     }
 
 
+def _verifyd_worker(n_sigs: int) -> None:
+    """verifyd config, worker half (runs in a subprocess): flood one
+    hub with single-signature submissions and report aggregate rate +
+    per-signature latency percentiles. With TMTPU_VERIFYD_SOCK in the
+    env the hub ships its packed batches to the shared daemon (the
+    sidecar shape); without it the worker pays its own in-process
+    backend — the N-cold-attaches baseline."""
+    import time as _t
+
+    from tendermint_tpu.crypto import backend_telemetry as bt
+    from tendermint_tpu.crypto import verifyd as vdmod
+    from tendermint_tpu.crypto.ed25519 import Ed25519PrivKey
+    from tendermint_tpu.crypto.verify_hub import VerifyHub
+
+    wid = os.environ.get("_TMTPU_VD_WORKER", "0")
+    priv = Ed25519PrivKey(int(wid).to_bytes(4, "big") * 8)
+    pub = priv.pub_key()
+    tag = b"vd-bench-%s-" % wid.encode()
+    items = [(tag + b"%d" % i, priv.sign(tag + b"%d" % i)) for i in range(n_sigs)]
+
+    hub = VerifyHub(window_ms=2.0, cache_size=0)
+    hub.start()
+    lats: list[float] = []
+    bad: list[int] = []
+    try:
+        futs = []
+        t0 = _t.perf_counter()
+        for msg, sig in items:
+            t_sub = _t.perf_counter()
+            fut = hub.submit_nowait(pub, msg, sig)
+            fut.add_done_callback(
+                lambda f, t=t_sub: lats.append(_t.perf_counter() - t)
+            )
+            futs.append(fut)
+        hub.flush()
+        for f in futs:
+            if not f.result(timeout=300):
+                bad.append(1)
+        dt = _t.perf_counter() - t0
+    finally:
+        hub.stop()
+    assert not bad, f"{len(bad)} wrong verdicts"
+    # hub.stop() above joined the runner thread that fires the
+    # done-callbacks; sorted() copies first anyway, so a straggler
+    # append can never corrupt the sort
+    lats = sorted(lats)
+    p = lambda q: lats[min(len(lats) - 1, int(q * len(lats)))] if lats else 0.0  # noqa: E731
+    print(
+        "VERIFYD_WORKER_JSON "
+        + json.dumps(
+            {
+                "sigs": n_sigs,
+                "dt_s": round(dt, 3),
+                "sigs_per_s": round(n_sigs / dt, 1),
+                "verify_p50_ms": round(p(0.50) * 1e3, 3),
+                "verify_p99_ms": round(p(0.99) * 1e3, 3),
+                "remote_dispatches": int(
+                    vdmod.CLIENT_STATS["remote_dispatches"]
+                ),
+                "remote_fallbacks": int(vdmod.CLIENT_STATS["remote_fallbacks"]),
+                "attach_attempts": int(bt.BACKEND["attach_attempts"]),
+            }
+        ),
+        flush=True,
+    )
+
+
+def bench_verifyd(
+    n_workers: int = 4, sigs_per_worker: int = 1000, timeout_s: float = 600.0
+) -> dict:
+    """verifyd config driver — BOUNDED, structured outcomes only (the
+    multichip discipline: hard subprocess timeouts, never an rc=124
+    probe). N worker processes flood ONE daemon over its UDS, then the
+    same N workers run against their own in-process backends; reports
+    aggregate sigs/s for both shapes, the attach counts (1 daemon
+    attach vs N worker attaches — the amortization headline), p50/p99
+    per-signature verify latency, and the daemon's cross-client batch
+    occupancy. On CPU-only images local workers set TMTPU_DISABLE_TPU
+    (a JAX-CPU warm compile per worker would measure XLA, not the
+    socket); the attach-count A/B is the real-TPU-round story."""
+    import subprocess
+    import tempfile
+
+    sock = os.path.join(tempfile.mkdtemp(prefix="vd-bench-"), "vd.sock")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    base_env = dict(os.environ, PYTHONPATH=repo)
+
+    def run_workers(env_extra: dict) -> list[dict] | str:
+        procs = []
+        for i in range(n_workers):
+            env = dict(base_env, _TMTPU_VD_WORKER=str(i + 1), **env_extra)
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable,
+                        "-c",
+                        f"import bench; bench._verifyd_worker({sigs_per_worker})",
+                    ],
+                    env=env,
+                    cwd=repo,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL,
+                    text=True,
+                )
+            )
+        out = []
+        deadline = time.monotonic() + timeout_s
+        for p in procs:
+            try:
+                stdout, _ = p.communicate(
+                    timeout=max(1.0, deadline - time.monotonic())
+                )
+            except subprocess.TimeoutExpired:
+                # kill EVERY worker, not just the timed-out one: a
+                # leaked sibling would keep flooding through the local
+                # baseline pass and skew the A/B this config reports
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                for q in procs:
+                    q.wait()
+                return f"worker timeout after {timeout_s:.0f}s (bounded)"
+            for line in stdout.splitlines():
+                if line.startswith("VERIFYD_WORKER_JSON "):
+                    out.append(json.loads(line[len("VERIFYD_WORKER_JSON "):]))
+        if len(out) != n_workers:
+            return f"{len(out)}/{n_workers} workers reported"
+        return out
+
+    def agg(records: list[dict]) -> dict:
+        wall = max(r["dt_s"] for r in records)
+        return {
+            "sigs_per_s": round(sum(r["sigs"] for r in records) / wall, 1),
+            "verify_p50_ms": round(
+                sorted(r["verify_p50_ms"] for r in records)[len(records) // 2], 3
+            ),
+            "verify_p99_ms": round(max(r["verify_p99_ms"] for r in records), 3),
+            "attach_attempts": sum(r["attach_attempts"] for r in records),
+            "remote_dispatches": sum(r["remote_dispatches"] for r in records),
+            "remote_fallbacks": sum(r["remote_fallbacks"] for r in records),
+        }
+
+    out: dict = {"workers": n_workers, "sigs_per_worker": sigs_per_worker}
+    daemon_env = dict(base_env)
+    on_cpu = os.environ.get("TMTPU_BENCH_FORCED_CPU") == "1" or os.environ.get(
+        "JAX_PLATFORMS"
+    ) == "cpu"
+    if on_cpu:
+        # keep the daemon's background warm at the floor shape: the
+        # config measures socket amortization here, not XLA compile
+        daemon_env["TMTPU_MAX_BUCKET"] = "64"
+    daemon = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            "from tendermint_tpu.cli import main; "
+            f"main(['verifyd', '--sock', {sock!r}])",
+        ],
+        env=daemon_env,
+        cwd=repo,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    try:
+        from tendermint_tpu.crypto.verifyd import VerifydClient
+
+        stats = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            c = VerifydClient(sock)
+            stats = c.remote_stats()
+            c.close()
+            if stats is not None:
+                break
+            time.sleep(0.5)
+        if stats is None:
+            out["outcome"] = "daemon never came up (bounded)"
+            return out
+
+        remote = run_workers({"TMTPU_VERIFYD_SOCK": sock})
+        c = VerifydClient(sock)
+        dstats = c.remote_stats()
+        c.close()
+    finally:
+        daemon.kill()
+        daemon.wait()
+    local = run_workers(
+        {"TMTPU_DISABLE_TPU": "1"} if on_cpu else {"TMTPU_MAX_BUCKET": "64"}
+    )
+    if isinstance(remote, str) or isinstance(local, str):
+        out["outcome"] = remote if isinstance(remote, str) else local
+        return out
+    out["remote"] = agg(remote)
+    out["local"] = agg(local)
+    out["speedup_vs_local"] = round(
+        out["remote"]["sigs_per_s"] / max(out["local"]["sigs_per_s"], 1e-9), 2
+    )
+    if dstats is not None:
+        out["daemon"] = {
+            "attach_attempts": dstats["backend"]["attach_attempts"],
+            "active_kind": dstats["backend"]["active_kind"],
+            "requests": dstats["daemon"]["requests"],
+            "sigs": dstats["daemon"]["sigs"],
+            "shed": dstats["daemon"]["shed"],
+            "batch_occupancy": round(dstats["hub"]["mean_occupancy"], 2),
+            "cross_client_packs": dstats["hub"]["cross_tenant_dispatches"],
+        }
+        # the headline: one attach serves every worker on the host
+        out["attach_count_sidecar"] = dstats["backend"]["attach_attempts"]
+        out["attach_count_local"] = out["local"]["attach_attempts"]
+    out["outcome"] = "ok"
+    log(
+        f"verifyd: {out['remote']['sigs_per_s']:,.1f} sigs/s via sidecar "
+        f"(occupancy {out.get('daemon', {}).get('batch_occupancy', '?')}, "
+        f"{out.get('daemon', {}).get('cross_client_packs', '?')} cross-client "
+        f"packs, p99 {out['remote']['verify_p99_ms']}ms) vs "
+        f"{out['local']['sigs_per_s']:,.1f} local -> {out['speedup_vs_local']}x; "
+        f"attaches {out.get('attach_count_sidecar', '?')} vs "
+        f"{out.get('attach_count_local', '?')}"
+    )
+    return out
+
+
 def main() -> None:
     import numpy as np
 
@@ -1442,6 +1665,24 @@ def main() -> None:
         )
     except Exception as e:  # noqa: BLE001
         log(f"commit-ab bench failed: {e!r}")
+    # verifyd runs on BOTH backends, BOUNDED: N worker processes flood
+    # one sidecar daemon vs N in-process backends — aggregate sigs/s,
+    # attach counts (the one-warm-mesh amortization headline), p99
+    # verify latency, cross-client batch occupancy. CPU images scale
+    # down (the daemon verifies pure-python there; the attach-count A/B
+    # is the real-TPU-round story).
+    if os.environ.get("TMTPU_BENCH_VERIFYD") != "0":
+        try:
+            n_w = int(os.environ.get("TMTPU_BENCH_VERIFYD_WORKERS", "4"))
+            n_s = int(
+                os.environ.get(
+                    "TMTPU_BENCH_VERIFYD_SIGS",
+                    "2000" if backend != "cpu" else "200",
+                )
+            )
+            extra["verifyd"] = bench_verifyd(n_w, n_s)
+        except Exception as e:  # noqa: BLE001
+            log(f"verifyd bench failed: {e!r}")
     # multichip runs on BOTH backends, BOUNDED (the rc=124 probes were
     # the only multi-device signal for five rounds): sharded vs
     # single-device sigs/s + per-device shard occupancy, on the real

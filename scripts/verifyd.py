@@ -1,0 +1,27 @@
+#!/usr/bin/env python3
+"""Standalone driver for the verification sidecar (crypto/verifyd.py).
+
+Equivalent to `tendermint-tpu verifyd ...` — kept as a script so ops
+tooling (systemd units, the localnet harness, the bench driver) can
+start the daemon without installing the CLI entrypoint:
+
+    python scripts/verifyd.py --sock /run/tmtpu/verifyd.sock
+    python scripts/verifyd.py --sock /run/tmtpu/verifyd.sock --stats
+
+The daemon owns THE warm device mesh + persistent compile cache for the
+host; every node process pointed at the socket (TMTPU_VERIFYD_SOCK or
+`[verify_hub] verifyd_sock`) ships its cold verification micro-batches
+there instead of paying its own backend attach.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tendermint_tpu.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(["verifyd", *sys.argv[1:]]))

@@ -8,6 +8,8 @@
   reset      — wipe data, keep keys/config (unsafe-reset-all)
   light      — verify a height against a running node over RPC
   inspect    — read-only report over a stopped node's data dirs
+  verifyd    — run the verification sidecar (one warm device mesh
+               shared by every node process on the host over a UDS)
   version
 """
 
@@ -544,6 +546,57 @@ def cmd_version(args) -> int:
     return 0
 
 
+def cmd_verifyd(args) -> int:
+    """Run the verification sidecar (crypto/verifyd.py): one process
+    owns the warm device mesh + compile cache and serves batched
+    signature verification to every node process on this host over a
+    Unix-domain socket. Point nodes at it with TMTPU_VERIFYD_SOCK or
+    `[verify_hub] verifyd_sock`. With --stats, query a RUNNING daemon's
+    telemetry instead (attach counts, occupancy, shed) and print JSON."""
+    import logging
+
+    from .crypto.verifyd import VerifyDaemon, client_for
+
+    sock = os.path.expanduser(args.sock) or os.path.join(_home(args), "verifyd.sock")
+    if args.stats:
+        stats = client_for(sock).remote_stats()  # tmtlint: allow[verify-chokepoint] -- operator telemetry query, not a verify path
+        if stats is None:
+            print(f"no verifyd reachable on {sock}", file=sys.stderr)
+            return 1
+        print(json.dumps(stats, indent=2, sort_keys=True))
+        return 0
+
+    logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
+
+    async def run() -> None:
+        daemon = VerifyDaemon(
+            sock,
+            max_batch=args.max_batch,
+            window_ms=args.window_ms,
+            cache_size=args.cache,
+            max_inflight=args.max_inflight,
+            warm_backend=not args.no_warm,
+        )
+        await daemon.start()
+        print(f"verifyd listening on {sock}", flush=True)
+        stop = asyncio.Event()
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        try:
+            await stop.wait()
+        finally:
+            await daemon.stop()
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_signer_harness(args) -> int:
     """Acceptance-test a remote signer (reference
     tools/tm-signer-harness/main.go:1)."""
@@ -606,6 +659,35 @@ def main(argv: list[str] | None = None) -> int:
         fn=cmd_inspect
     )
     sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    p_vd = sub.add_parser(
+        "verifyd",
+        help="run the verification sidecar (one warm device mesh shared "
+        "by every node process on this host over a Unix socket)",
+    )
+    p_vd.add_argument(
+        "--sock", default="", help="UDS path (default <home>/verifyd.sock)"
+    )
+    p_vd.add_argument("--max-batch", type=int, default=None)
+    p_vd.add_argument("--window-ms", type=float, default=None)
+    p_vd.add_argument("--cache", type=int, default=None)
+    p_vd.add_argument(
+        "--max-inflight",
+        type=int,
+        default=None,
+        help="in-flight signature cap before busy-shedding",
+    )
+    p_vd.add_argument(
+        "--no-warm",
+        action="store_true",
+        help="skip the startup backend probe/warm (tests)",
+    )
+    p_vd.add_argument(
+        "--stats",
+        action="store_true",
+        help="query a running daemon's telemetry as JSON and exit",
+    )
+    p_vd.set_defaults(fn=cmd_verifyd)
 
     p_sh = sub.add_parser(
         "signer-harness",

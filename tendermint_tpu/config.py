@@ -208,6 +208,14 @@ class VerifyHubConfig:
     # size, so an 8-chip mesh is fed 8× batches (and a degraded mesh
     # shrinks them again); TMTPU_MESH_SCALE env overrides
     mesh_scale: bool = True
+    # verification sidecar (crypto/verifyd.py): path of a running
+    # verifyd daemon's Unix socket. When set, the hub ships its packed
+    # cold micro-batches there instead of dispatching locally — N node
+    # processes on one host share the daemon's single warm device mesh
+    # and compile cache. A daemon crash degrades to inline local
+    # verification through a circuit breaker (never a liveness event).
+    # Env mirror: TMTPU_VERIFYD_SOCK (wins over TOML).
+    verifyd_sock: str = ""
 
 
 @dataclass

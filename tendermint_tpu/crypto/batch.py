@@ -260,7 +260,10 @@ MIN_TPU_BATCH = int(os.environ.get("TMTPU_MIN_TPU_BATCH", "32"))
 #: where the most recent adaptive batch actually executed ("tpu",
 #: "cpu", or "cpu-fallback" after a device error). Diagnostics only —
 #: the VerifyHub stamps it on dispatch spans so a trace dump shows
-#: which backend served each batch.
+#: which backend served each batch. (The hub's REMOTE route stamps
+#: "verifyd" on its spans directly — a batch shipped to the sidecar
+#: daemon never reaches this module in the client process; the
+#: daemon's own hub records the device route on ITS spans.)
 LAST_ROUTE = "cpu"
 
 

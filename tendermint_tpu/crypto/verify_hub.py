@@ -55,9 +55,20 @@ and a breaker-degraded mesh shrinks them the same iteration. Sharded
 dispatches stamp per-device shard occupancy onto their hub.dispatch
 spans (scripts/tracectl.py --per-device).
 
+Remote route (crypto/verifyd.py): when ``TMTPU_VERIFYD_SOCK`` /
+``[verify_hub] verifyd_sock`` points at a verifyd sidecar's Unix socket,
+`_verify_batch` ships its packed cold batches to the daemon instead of
+dispatching locally — the adaptive window, verdict cache, coalescing and
+lanes all stay client-side, so the socket carries only what the local
+cache could not answer, and the daemon re-batches across every client
+process on the host (one warm device mesh, N node processes). Any
+remote failure degrades to the local path below through a circuit
+breaker, exactly like the TPU→CPU degrade.
+
 Env knobs (override per-node config): TMTPU_VERIFYHUB_DISABLE=1,
 TMTPU_VERIFYHUB_BATCH, TMTPU_VERIFYHUB_WINDOW_MS, TMTPU_VERIFYHUB_CACHE,
-TMTPU_MESH_SCALE=0 (pin single-chip batch sizing).
+TMTPU_MESH_SCALE=0 (pin single-chip batch sizing), TMTPU_VERIFYD_SOCK
+(remote sidecar route).
 """
 
 from __future__ import annotations
@@ -99,9 +110,12 @@ class _Pending:
 
     __slots__ = (
         "key", "pub_key", "msg", "sig", "futures", "enqueued_at", "lane", "traces",
+        "tenants",
     )
 
-    def __init__(self, key, pub_key, msg, sig, fut, now, lane, trace_ctx=None):
+    def __init__(
+        self, key, pub_key, msg, sig, fut, now, lane, trace_ctx=None, tenant=None
+    ):
         self.key = key
         self.pub_key = pub_key
         self.msg = msg
@@ -113,6 +127,19 @@ class _Pending:
         # when IT joined, not when the first submitter enqueued — else
         # its queue span would begin before its own trace did
         self.traces: list | None = [(trace_ctx, now)] if trace_ctx is not None else None
+        # multi-tenant tag (the verifyd daemon stamps each client's
+        # connection id): a dispatch whose batch carries >1 distinct
+        # tenant is a cross-client pack — the sidecar's amortization
+        # win, counted instead of assumed
+        self.tenants: set | None = {tenant} if tenant is not None else None
+
+    def add_tenant(self, tenant) -> None:
+        if tenant is None:
+            return
+        if self.tenants is None:
+            self.tenants = {tenant}
+        else:
+            self.tenants.add(tenant)
 
     def add_trace(self, trace_ctx) -> None:
         if trace_ctx is None:
@@ -145,6 +172,8 @@ class VerifyHub:
         cache_size: int | None = None,
         adaptive: bool = True,
         mesh_scale: bool | None = None,
+        verifyd_sock: str | None = None,
+        allow_remote: bool = True,
         name: str = "verify-hub",
     ):
         # env wins over explicit kwargs (the node always passes its
@@ -174,6 +203,19 @@ class VerifyHub:
             defaults.mesh_scale,
             lambda v: v.lower() not in ("0", "false", "no"),
         )
+        # remote verification sidecar (crypto/verifyd.py): when a socket
+        # path is configured, _verify_batch ships packed cold batches to
+        # the verifyd daemon instead of dispatching locally — the cache,
+        # window, coalescing and lanes above all stay client-side.
+        # allow_remote=False is the daemon's own hub (it must never
+        # route back into itself); not env-overridable by design.
+        if allow_remote:
+            verifyd_sock = _knob(
+                "TMTPU_VERIFYD_SOCK", verifyd_sock, defaults.verifyd_sock, str
+            )
+        else:
+            verifyd_sock = ""
+        self.verifyd_sock = verifyd_sock or ""
         self.name = name
         self.max_batch = max(1, max_batch)
         self.window_s = max(0.0, window_ms) / 1e3
@@ -228,6 +270,9 @@ class VerifyHub:
             # the pairing path — rendered as verifyhub_scheme_sigs{scheme=})
             "scheme_edwards_sigs": 0.0,
             "scheme_bls_sigs": 0.0,
+            # multi-tenant packing (the verifyd daemon's hub): dispatches
+            # whose batch mixed signatures from >1 client connection
+            "cross_tenant_dispatches": 0.0,
         }
 
     # -- lifecycle -------------------------------------------------------
@@ -276,6 +321,7 @@ class VerifyHub:
         urgent: bool = False,
         lane: str = LANE_LIVE,
         trace_ctx=None,
+        tenant=None,
     ) -> Future:
         """Enqueue one verification; returns a concurrent Future[bool].
 
@@ -317,6 +363,7 @@ class VerifyHub:
             if pending is not None:
                 pending.futures.append(fut)
                 pending.add_trace(trace_ctx)
+                pending.add_tenant(tenant)
                 self._stats["coalesced"] += 1
                 if (
                     lane == LANE_LIVE
@@ -343,7 +390,7 @@ class VerifyHub:
                 q = self._queues[lane]
                 q[key] = _Pending(
                     key, pub_key, msg, sig, fut, time.monotonic(), lane,
-                    trace_ctx=trace_ctx,
+                    trace_ctx=trace_ctx, tenant=tenant,
                 )
                 self._stats["submitted"] += 1
                 self._stats[f"lane_{lane}_submitted"] += 1
@@ -577,6 +624,14 @@ class VerifyHub:
                             )
                 self._stats["dispatches"] += 1
                 self._stats["dispatched_sigs"] += len(batch)
+                tenants: set = set()
+                for p in batch:
+                    if p.tenants:
+                        tenants.update(p.tenants)
+                if len(tenants) > 1:
+                    # >1 verifyd client packed into ONE dispatch — the
+                    # cross-process amortization the sidecar exists for
+                    self._stats["cross_tenant_dispatches"] += 1
                 alpha = 0.2
                 self._ewma_occupancy = (
                     (1 - alpha) * self._ewma_occupancy + alpha * len(batch)
@@ -663,15 +718,56 @@ class VerifyHub:
                 if not f.done():
                     f.set_result(ok)
 
+    def _remote(self, purpose: str = "batch"):
+        """The verifyd sidecar client for this hub's configured socket,
+        or None when no remote route is configured. The client is
+        process-wide (crypto/verifyd.client_for): every hub pointing at
+        one socket shares one connection + breaker per purpose —
+        aggregate checks get their own connection so a seconds-scale
+        pairing round-trip never queues live vote batches behind it."""
+        if not self.verifyd_sock:
+            return None
+        from . import verifyd
+
+        return verifyd.client_for(self.verifyd_sock, purpose)
+
     def _verify_batch(self, batch: list[_Pending]) -> list[bool]:
-        """One batched verify per scheme per dispatch. Batchable key
-        types are PARTITIONED by scheme — ed25519/sr25519 share the
-        Edwards MSM kernel, bls12381 runs the pairing kernel / pure
-        path — so a mixed-scheme micro-batch never packs both into one
-        kernel dispatch. Each partition gets its own
-        AdaptiveBatchVerifier (TPU/CPU routing, breaker, and
+        """One batched verify per scheme per dispatch.
+
+        Remote route first: when a verifyd sidecar is configured
+        (`verifyd_sock`), the whole packed batch ships over the UDS and
+        the daemon's hub re-batches it ACROSS client processes — the
+        local cache/coalescing above already filtered everything warm,
+        so the socket only carries cold batches. Any remote failure
+        (breaker open, daemon busy, socket error) returns None from the
+        client and the batch falls through to the local path below: the
+        sidecar can never be a correctness or liveness event.
+
+        Local path: batchable key types are PARTITIONED by scheme —
+        ed25519/sr25519 share the Edwards MSM kernel, bls12381 runs the
+        pairing kernel / pure path — so a mixed-scheme micro-batch
+        never packs both into one kernel dispatch. Each partition gets
+        its own AdaptiveBatchVerifier (TPU/CPU routing, breaker, and
         identical-result fallback live there); anything unbatchable
         verifies on the host individually."""
+        remote = self._remote()
+        if remote is not None:
+            verdicts = remote.remote_verify_batch(
+                [(p.pub_key, p.msg, p.sig, p.lane) for p in batch]
+            )
+            if verdicts is not None:
+                # stamp the route for the hub.dispatch span: tracectl
+                # can then attribute socket RTT vs local device time
+                self._route_local.route = "verifyd"
+                self._route_local.dispatch = None
+                with self._cv:
+                    for p in batch:
+                        scheme = (
+                            "bls" if p.pub_key.TYPE == "bls12381" else "edwards"
+                        )
+                        if supports_batch_verifier(p.pub_key):
+                            self._stats[f"scheme_{scheme}_sigs"] += 1
+                return verdicts
         results = [False] * len(batch)
         # scheme partitions in deterministic order (dict preserves
         # first-seen insertion; verdicts are order-independent anyway)
@@ -729,10 +825,13 @@ def acquire_hub(**kwargs) -> VerifyHub:
             _default_hub = VerifyHub(**kwargs)
             _default_hub.start()
             logger.info(
-                "verify hub started (max_batch=%d window=%.1fms cache=%d)",
+                "verify hub started (max_batch=%d window=%.1fms cache=%d%s)",
                 _default_hub.max_batch,
                 _default_hub.window_s * 1e3,
                 _default_hub.cache_size,
+                f" verifyd={_default_hub.verifyd_sock}"
+                if _default_hub.verifyd_sock
+                else "",
             )
         _refs += 1
         return _default_hub
@@ -779,16 +878,10 @@ async def averify_one(
         return pub_key.verify_signature(msg, sig)
 
 
-def verify_aggregate(pub_keys: list, msgs: list[bytes], agg_sig: bytes) -> bool:
-    """THE aggregate-commit chokepoint (types/validation routes every
-    aggregate `verify_commit*` here): one G2 aggregate signature
-    checked against per-signer messages via a single pairing product.
-    The check is indivisible — nothing to micro-batch — so it runs on
-    the caller's thread through crypto/batch.bls_aggregate_verify
-    (device routing + breaker + pure-Python fallback), but the running
-    hub's verdict LRU still answers gossip re-verifications of the
-    same commit without re-pairing."""
-    key = (
+def aggregate_cache_key(pub_keys: list, msgs: list[bytes], agg_sig: bytes) -> tuple:
+    """Verdict-LRU key for one aggregate-commit check. Shared with the
+    verifyd daemon so both sides of the socket cache identically."""
+    return (
         "bls-aggregate",
         sha256(
             b"".join(
@@ -798,11 +891,32 @@ def verify_aggregate(pub_keys: list, msgs: list[bytes], agg_sig: bytes) -> bool:
         ),
         bytes(agg_sig),
     )
+
+
+def verify_aggregate(pub_keys: list, msgs: list[bytes], agg_sig: bytes) -> bool:
+    """THE aggregate-commit chokepoint (types/validation routes every
+    aggregate `verify_commit*` here): one G2 aggregate signature
+    checked against per-signer messages via a single pairing product.
+    The check is indivisible — nothing to micro-batch — so it runs on
+    the caller's thread through crypto/batch.bls_aggregate_verify
+    (device routing + breaker + pure-Python fallback), but the running
+    hub's verdict LRU still answers gossip re-verifications of the
+    same commit without re-pairing. With a verifyd sidecar configured,
+    a cache miss ships the check over the socket first (the daemon's
+    warm pairing kernel + cross-client verdict cache); remote failure
+    degrades to the local path like every other sidecar call."""
+    key = aggregate_cache_key(pub_keys, msgs, agg_sig)
     hub = running_hub()
     if hub is not None:
         hit = hub.cached_verdict(key)
         if hit is not None:
             return hit
+        remote = hub._remote("aggregate")
+        if remote is not None:
+            v = remote.remote_verify_aggregate(pub_keys, msgs, agg_sig)
+            if v is not None:
+                hub.store_verdict(key, v)
+                return v
     from .batch import bls_aggregate_verify
 
     ok = bls_aggregate_verify(pub_keys, msgs, agg_sig)

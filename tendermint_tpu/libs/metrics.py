@@ -390,6 +390,47 @@ class NodeMetrics:
             "scheme_sigs",
             "signatures dispatched per signature scheme partition",
         )
+        # remote verification sidecar, client side (crypto/verifyd.py —
+        # module-level stores like RESILIENCE: the remote route is
+        # process-wide, shared by every in-process hub)
+        self.verifyhub_remote_dispatches = r.counter(
+            "verifyhub", "remote_dispatches",
+            "micro-batches answered by the verifyd sidecar over the socket",
+        )
+        self.verifyhub_remote_fallbacks = r.counter(
+            "verifyhub", "remote_fallbacks",
+            "micro-batches verified inline-local because the sidecar was "
+            "unreachable, busy, or scheme-incompatible",
+        )
+        from ..crypto.verifyd import REMOTE_RTT
+
+        self.verifyhub_remote_rtt = r.histogram(
+            "verifyhub",
+            "remote_rtt_seconds",
+            "verifyd socket round-trip per remote batch",
+            buckets=REMOTE_RTT.buckets,
+        )
+        # verifyd daemon side (folded from in-process daemons; a
+        # standalone daemon serves the same numbers over its protocol
+        # `stats` request / `cli verifyd --stats`)
+        self.verifyd_clients = r.gauge(
+            "verifyd", "clients", "client connections currently open"
+        )
+        self.verifyd_requests = r.counter(
+            "verifyd", "requests", "verify_batch requests served"
+        )
+        self.verifyd_occupancy = r.gauge(
+            "verifyd", "batch_occupancy",
+            "mean signatures per daemon-hub dispatch (cross-client packed)",
+        )
+        self.verifyd_cross_client_packs = r.counter(
+            "verifyd", "cross_client_packs",
+            "device dispatches that mixed signatures from >1 client process",
+        )
+        self.verifyd_shed = r.counter(
+            "verifyd", "shed",
+            "requests answered busy at the bounded in-flight cap",
+        )
         # BLS aggregate-commit path (crypto/bls.STATS, folded at render)
         self.bls_verifies = r.counter(
             "bls", "verifies", "single BLS signature verifications (memo misses)"
@@ -565,6 +606,29 @@ class NodeMetrics:
             dst._sum = sum_
             dst._count = count
 
+    def _fold_verifyd(self) -> None:
+        from ..crypto import verifyd
+
+        # client side: process-wide module stores (always present)
+        cs = verifyd.CLIENT_STATS
+        self.verifyhub_remote_dispatches._values[()] = cs["remote_dispatches"]
+        self.verifyhub_remote_fallbacks._values[()] = cs["remote_fallbacks"]
+        counts, sum_, count = verifyd.remote_rtt_snapshot()
+        dst = self.verifyhub_remote_rtt
+        if len(counts) == len(dst._counts):
+            dst._counts = counts
+            dst._sum = sum_
+            dst._count = count
+        # daemon side: only when a daemon runs in THIS process
+        agg = verifyd.aggregate_daemons()
+        if agg is None:
+            return
+        self.verifyd_clients.set(agg["clients"])
+        self.verifyd_requests._values[()] = agg["requests"]
+        self.verifyd_occupancy.set(round(agg["batch_occupancy"], 3))
+        self.verifyd_cross_client_packs._values[()] = agg["cross_client_packs"]
+        self.verifyd_shed._values[()] = agg["shed"]
+
     def _fold_ingest(self) -> None:
         from ..consensus import ingest
 
@@ -693,6 +757,7 @@ class NodeMetrics:
         self.wal_repairs._values[()] = STORAGE["wal_repairs"]
         self.wal_truncated_bytes._values[()] = STORAGE["wal_truncated_bytes"]
         self._fold_verify_hub()
+        self._fold_verifyd()
         self._fold_ingest()
         self._fold_mempool()
         self._fold_steps()

@@ -362,7 +362,13 @@ class Node(Service):
                 window_ms=self.config.verify_hub.window_ms,
                 cache_size=self.config.verify_hub.cache_size,
                 mesh_scale=self.config.verify_hub.mesh_scale,
+                verifyd_sock=self.config.verify_hub.verifyd_sock,
             )
+            if self.verify_hub.verifyd_sock:
+                self.logger.info(
+                    "verification sidecar route enabled: %s",
+                    self.verify_hub.verifyd_sock,
+                )
         if self.config.watchdog_dir:
             from .libs.watchdog import LoopWatchdog
 

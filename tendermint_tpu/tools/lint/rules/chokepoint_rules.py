@@ -41,10 +41,13 @@ class VerifyChokepoint(Rule):
         "no direct *.verify_signature() outside the crypto/handshake/"
         "harness allowlist — route through crypto/verify_hub; no "
         "sync-facade verification (verify_sync / submit_nowait().result())"
-        " inside coroutines in consensus/blocksync/statesync; and no "
+        " inside coroutines in consensus/blocksync/statesync; no "
         "direct BLS pairing/aggregate-verify calls outside crypto/ — "
         "route aggregate commits through verify_hub.verify_aggregate "
-        "(the pairing modules must not grow a second verify funnel)"
+        "(the pairing modules must not grow a second verify funnel); "
+        "and no direct verifyd socket-protocol calls outside crypto/ — "
+        "crypto/verifyd is the ONLY legal raw-socket verify path (set "
+        "[verify_hub] verifyd_sock and let the hub route)"
     )
     scope = ("tendermint_tpu/",)
     profiles = ("node",)
@@ -64,6 +67,21 @@ class VerifyChokepoint(Rule):
             "bls_aggregate_verify",
             "verify_pairs_batch",
             "verify_items",
+        }
+    )
+
+    #: the verifyd sidecar protocol surface (crypto/verifyd.py): a
+    #: direct socket verify outside crypto/ bypasses the hub's verdict
+    #: cache, lanes, AND the circuit-breaker fallback contract — a
+    #: daemon crash at such a call site becomes a liveness event
+    #: instead of an inline-local degrade. `remote_stats` stays legal
+    #: (diagnostics, not a verify path).
+    VERIFYD_FUNNEL_CALLS = frozenset(
+        {
+            "remote_verify_batch",
+            "remote_verify_aggregate",
+            "VerifydClient",
+            "client_for",
         }
     )
 
@@ -109,6 +127,20 @@ class VerifyChokepoint(Rule):
                     "commits route through crypto/verify_hub."
                     "verify_aggregate (verdict cache + breaker-guarded "
                     "device routing)",
+                )
+                continue
+            if (
+                name is not None
+                and name.rsplit(".", 1)[-1] in self.VERIFYD_FUNNEL_CALLS
+            ):
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"direct verifyd `{name.rsplit('.', 1)[-1]}()` outside "
+                    "crypto/ — the sidecar protocol module is the only "
+                    "legal raw-socket verify path; set [verify_hub] "
+                    "verifyd_sock and route through the hub (verdict "
+                    "cache, lanes, breaker-guarded inline-local fallback)",
                 )
                 continue
             if not (in_async_scope and ctx.in_async_def(node)):

@@ -466,6 +466,50 @@ def test_bls_funnel_clean_cases():
     )
 
 
+def test_verifyd_funnel_calls_flagged_outside_crypto():
+    """crypto/verifyd is the ONLY legal raw-socket verify path: a call
+    site talking to the sidecar directly skips the hub's verdict cache,
+    lanes, AND the breaker's inline-local fallback — a daemon crash
+    there becomes a liveness event instead of a degrade."""
+    src = """
+    def fast_verify(self, items):
+        client = client_for(self.sock_path)
+        return client.remote_verify_batch(items)
+    def agg(self, pubs, msgs, sig):
+        return verifyd.VerifydClient(self.sock).remote_verify_aggregate(pubs, msgs, sig)
+    """
+    fs = run(src, "verify-chokepoint", rel="tendermint_tpu/blocksync/pool.py")
+    assert len(fs) == 4  # client_for + remote_verify_batch + ctor + agg
+    assert all("raw-socket verify path" in f.message for f in fs)
+    # consensus is equally fenced
+    assert len(run(src, "verify-chokepoint", rel="tendermint_tpu/consensus/state.py")) == 4
+
+
+def test_verifyd_funnel_clean_cases():
+    # the hub route (config knob) and diagnostics stay legal outside
+    # crypto/; inside crypto/ the client IS the chokepoint (allowlisted)
+    src = """
+    def build_hub(self, cfg):
+        return VerifyHub(verifyd_sock=cfg.verifyd_sock)
+    def diagnostics(self, client):
+        return client.remote_stats()
+    """
+    assert run(src, "verify-chokepoint", rel="tendermint_tpu/node.py") == []
+    direct = """
+    def route(self, batch):
+        return client_for(self.verifyd_sock).remote_verify_batch(batch)
+    """
+    assert (
+        run(
+            direct,
+            "verify-chokepoint",
+            rel="tendermint_tpu/crypto/verify_hub.py",
+            allowlist=Allowlist.load(DEFAULT_ALLOWLIST),
+        )
+        == []
+    )
+
+
 # ---------------------------------------------------------------------------
 # unbounded-queue
 
