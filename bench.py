@@ -595,6 +595,78 @@ def bench_crash_recovery(n_heights: int = 400, msgs_per_height: int = 20) -> dic
         shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_chaos_soak(sizes: tuple = (4, 50)) -> dict:
+    """chaos_soak config: the robustness trajectory MEASURED, not
+    asserted — blocks/s and time-to-recover per named fault scenario
+    (consensus/scenarios.py) at 4 and 50 validators, over REAL routers +
+    ChaosTransport (RouterNet). BOUNDED, structured outcomes (the
+    multichip discipline): every run carries the scenario engine's own
+    liveness-watchdog deadline plus an outer asyncio timeout, and a
+    wedge/timeout is a record, never a hang. The committee scale is wall
+    clock, so 50-validator rows run a trimmed scenario list with a
+    height-2 target."""
+    import asyncio
+
+    from tendermint_tpu.consensus import scenarios as sc
+
+    seed = int(os.environ.get("TMTPU_BENCH_SOAK_SEED", "7") or 7)
+    out: dict = {"seed": seed, "runs": []}
+    for n_vals in sizes:
+        small = n_vals <= 8
+        names = (
+            list(sc.SCENARIOS)
+            if small
+            else [
+                "baseline",
+                "lossy_links",
+                "corrupt_wire",
+                "asym_partition",
+                "full_taxonomy",
+            ]
+        )
+        target = 3 if small else 2
+        timeout_s = 75.0 if small else 300.0
+        for name in names:
+            t0 = time.perf_counter()
+
+            async def one(_name=name, _n=n_vals, _target=target, _to=timeout_s):
+                return await sc.run_scenario(
+                    _name,
+                    n_vals=_n,
+                    target_height=_target,
+                    seed=seed,
+                    timeout_s=_to,
+                    stall_s=25.0 if small else 90.0,
+                    time_scale=1.0 if small else 4.0,
+                    degree=8,
+                )
+
+            try:
+                res = asyncio.run(
+                    asyncio.wait_for(one(), timeout_s + 60.0)
+                ).as_dict()
+            except Exception as e:  # noqa: BLE001 — structured outcome
+                res = {
+                    "scenario": name,
+                    "n_vals": n_vals,
+                    "outcome": f"error: {e!r}"[:200],
+                }
+            res["wall_s"] = round(time.perf_counter() - t0, 2)
+            out["runs"].append(res)
+            rec = res.get("recover_s")
+            log(
+                f"chaos_soak {n_vals:>3}v {name:<18} "
+                f"{res.get('outcome', '?'):<7} "
+                f"{res.get('blocks_per_s', 0)} blk/s "
+                f"recover={'-' if rec is None else f'{rec}s'} "
+                f"wall={res['wall_s']}s"
+            )
+    ok = [r for r in out["runs"] if r.get("outcome") == "ok"]
+    out["ok_runs"] = len(ok)
+    out["total_runs"] = len(out["runs"])
+    return out
+
+
 def bench_verify_hub(
     n_vals: int, n_submitters: int = 8, per_submitter: int = 200
 ) -> dict:
@@ -1653,6 +1725,23 @@ def main() -> None:
         extra["crash_recovery"] = bench_crash_recovery()
     except Exception as e:  # noqa: BLE001
         log(f"crash-recovery bench failed: {e!r}")
+    # chaos_soak runs on BOTH backends, BOUNDED: blocks/s +
+    # time-to-recover per fault scenario over real routers +
+    # ChaosTransport (RouterNet) at 4 and 50 validators — the robustness
+    # trajectory measured per round. Pure host/event-loop work; the
+    # device is not on this path.
+    if os.environ.get("TMTPU_BENCH_CHAOS_SOAK") != "0":
+        try:
+            soak_vals = tuple(
+                int(v)
+                for v in os.environ.get(
+                    "TMTPU_BENCH_SOAK_VALS", "4,50"
+                ).split(",")
+                if v.strip()
+            )
+            extra["chaos_soak"] = bench_chaos_soak(soak_vals)
+        except Exception as e:  # noqa: BLE001
+            log(f"chaos-soak bench failed: {e!r}")
     # commit_ab runs on BOTH backends: the aggregate-signature A/B —
     # EdDSA-batch vs BLS-aggregate on the same 150-validator chain
     # (commit wire bytes x verify sigs/s x catch-up blocks/s). On CPU

@@ -31,6 +31,10 @@ from ..types.genesis import GenesisDoc, GenesisValidator
 
 MS = 1_000_000
 
+# every harness genesis is stamped here; chaos runs park a frozen
+# ManualClock at/behind it so the vote-time floor pins all timestamps
+GENESIS_TIME_NS = 1_700_000_000_000_000_000
+
 
 async def _deliver_after(delay: float, coro) -> None:
     try:
@@ -87,7 +91,7 @@ def make_genesis(
     doc = GenesisDoc(
         chain_id=chain_id,
         initial_height=1,
-        genesis_time_ns=1_700_000_000_000_000_000,
+        genesis_time_ns=GENESIS_TIME_NS,
         validators=gvals,
     )
     return doc, keys
@@ -106,22 +110,31 @@ class Node:
         app=None,
         fs=None,  # libs/chaosfs.FS — storage fault injection for the WAL
         clock=None,  # libs/clock.Clock — injectable consensus time
+        block_store=None,  # reuse across crash/restart cycles (RouterNet)
+        state_store=None,
     ):
         self.genesis = genesis
         self.config = config or fast_config()
         self.app = app or KVStoreApp()
         self.app_conns = AppConns.local(self.app)
-        self.block_store = BlockStore(MemDB())
-        self.state_store = StateStore(MemDB())
+        self.block_store = block_store or BlockStore(MemDB())
+        self.state_store = state_store or StateStore(MemDB())
         self.event_bus = EventBus()
         self.priv_val = MockPV(priv_key) if priv_key is not None else None
         self.clock = clock
-        self.wal = WAL(wal_dir or tempfile.mkdtemp(prefix="cswal-"), fs=fs)
+        self.fs = fs
+        self.wal_dir = wal_dir or tempfile.mkdtemp(prefix="cswal-")
+        self.wal = WAL(self.wal_dir, fs=fs)
         self.mempool: PriorityMempool | None = None
         self.evidence_pool: EvidencePool | None = None
         self.cs: ConsensusState | None = None
 
-    async def start(self) -> None:
+    async def start(self, *, start_consensus: bool = True) -> None:
+        """Build the stack and (by default) start the consensus SM.
+        `start_consensus=False` leaves `self.cs` built but not running —
+        RouterNet attaches the ConsensusReactor's hooks first, exactly
+        like node.py starts the reactor before the SM, so the first
+        proposal broadcast is not lost."""
         state = self.state_store.load()
         if state is None:
             state = state_from_genesis(self.genesis)
@@ -156,7 +169,8 @@ class Node:
             mempool=self.mempool,
             clock=self.clock,
         )
-        await self.cs.start()
+        if start_consensus:
+            await self.cs.start()
 
     async def stop(self) -> None:
         if self.cs is not None:
@@ -174,10 +188,11 @@ class LocalNetwork:
     hook wiring — drops, asymmetric partitions, delays, reorders, and
     duplicates apply per (sender→receiver) link; node ids are
     "node0".."nodeN-1". Corruption and bandwidth shaping are
-    byte-stream faults the typed-message hooks cannot model — use the
-    real router + ChaosTransport (tests/chaos_net.py) for those; don't
-    set their rates here, or the fault counters will report injections
-    the hook never performed. When the chaos config carries
+    byte-stream faults the typed-message hooks cannot model — the
+    constructor REJECTS configs that set their rates (the fault
+    counters would report injections the hook never performed); run
+    those classes over consensus.routernet.RouterNet, which speaks the
+    real router + ChaosTransport byte path. When the chaos config carries
     `clock_skew_ms`, each validator runs on its own deterministically
     skewed clock (over `base_clock` if given — a frozen `ManualClock`
     base makes the whole run's vote/block timestamps
@@ -193,6 +208,31 @@ class LocalNetwork:
         catchup: bool = True,
         key_type: str = "ed25519",
     ):
+        if chaos is not None:
+            # byte-stream fault classes the typed hooks can NEVER inject:
+            # accepting them here would still bump the `corrupt`/`shaped`
+            # fault counters in ChaosNetwork.plan while no corruption or
+            # shaping ever happens — a chaos matrix that silently lies
+            # about its own coverage. Fail loud; RouterNet
+            # (consensus/routernet.py) runs those classes over the real
+            # router + ChaosTransport byte path.
+            cfgs = [chaos.config, *chaos.config.per_channel.values()]
+            bad = sorted(
+                {
+                    name
+                    for cfg in cfgs
+                    for name in ("corrupt_rate", "bandwidth_rate")
+                    if getattr(cfg, name)
+                }
+            )
+            if bad:
+                raise ValueError(
+                    f"LocalNetwork cannot model byte-stream faults {bad}: "
+                    "the typed broadcast hooks never serialize messages, so "
+                    "those injections would be counted but never performed. "
+                    "Use consensus.routernet.RouterNet (real p2p.Router + "
+                    "ChaosTransport) for corruption/bandwidth chaos."
+                )
         self.genesis, self.keys = make_genesis(n_vals, key_type=key_type)
         self.chaos = chaos
         self.catchup = catchup

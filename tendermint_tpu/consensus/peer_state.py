@@ -156,6 +156,52 @@ class PeerState:
         elif old is prs.catchup_commit:
             prs.catchup_commit = new
 
+    def reset_gossip_marks(self) -> None:
+        """Forget what we believe the peer already holds (proposal flag,
+        part bits, vote bits, catch-up bits) while KEEPING its claimed
+        height/round/step. The gossip routines mark an item as delivered
+        at SEND time, so a frame the wire ate — dropped, or corrupted
+        into something else — leaves a false positive that is never
+        resent. The reactor calls this when a link looks wedged (both
+        round states static, nothing left to send): the next gossip
+        passes re-offer everything, and the receiver's dedup (VoteSet /
+        PartSet add) makes re-sends idempotent."""
+        prs = self.prs
+        prs.proposal = False
+        prs.proposal_block_parts_header = None
+        prs.proposal_block_parts = None
+        prs.proposal_pol_round = -1
+        prs.proposal_pol = None
+        prs.prevotes = {}
+        prs.precommits = {}
+        prs.last_commit = None
+        prs.catchup_commit_round = -1
+        prs.catchup_commit = None
+
+    def apply_vote_set_bits(self, msg, our_votes: BitArray | None) -> None:
+        """Reference peer_state.go ApplyVoteSetBitsMessage: a VoteSetBits
+        reply is an AUTHORITATIVE statement of what the peer holds for
+        the queried (height, round, type, block), so it REPLACES our
+        bookkeeping instead of or-ing into it. This is the only
+        mechanism that can clear a false `has_vote` mark — e.g. a
+        corrupted frame that still decoded as a plausible HasVote, or a
+        vote we sent that the wire silently ate — and without it one
+        poisoned bit starves the peer of that vote forever (a liveness
+        wedge the router-chaos matrix reproduces). `our_votes` (our own
+        bit array for the queried block) keeps bits for OTHER blocks
+        that the reply cannot speak for."""
+        votes = self._votes_bits(
+            msg.height, msg.round, msg.type, msg.votes.size
+        )
+        if votes is None:
+            return
+        if our_votes is None:
+            new = msg.votes.copy()
+        else:
+            other_block_bits = votes.sub(our_votes)
+            new = other_block_bits.or_(msg.votes)
+        self._replace_bits(msg.height, msg.round, msg.type, votes, new)
+
     def ensure_catchup_commit(self, height: int, round_: int, size: int) -> None:
         """Peer is far behind; track which precommits of `height`'s seen
         commit we have sent it (reference EnsureCatchupCommitRound)."""
@@ -167,13 +213,26 @@ class PeerState:
     def pick_vote_to_send(self, votes) -> Vote | None:
         """A vote from `votes` (a VoteSet) the peer does not have
         (reference PickSendVote/PickVoteToSend)."""
+        picked = self.pick_votes_to_send(votes, 1)
+        return picked[0] if picked else None
+
+    def pick_votes_to_send(self, votes, limit: int) -> list[Vote]:
+        """Up to `limit` votes the peer is missing — the batched gossip
+        pick. Committee-scale nets move votes in VoteBatch frames (one
+        envelope per ~32 votes instead of one each); which missing votes
+        go first doesn't affect correctness, so this takes them in
+        index order rather than paying a random draw per vote."""
         if votes is None or votes.size() == 0:
-            return None
+            return []
         ba = self._votes_bits(votes.height, votes.round, votes.type, votes.size())
         if ba is None:
-            return None
+            return []
         missing = votes.votes_bit_array.sub(ba)
-        idx = missing.pick_random()
-        if idx is None:
-            return None
-        return votes.get_vote(idx)
+        out: list[Vote] = []
+        for idx in missing.true_indices():
+            v = votes.get_vote(idx)
+            if v is not None:
+                out.append(v)
+                if len(out) >= limit:
+                    break
+        return out

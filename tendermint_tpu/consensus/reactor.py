@@ -38,6 +38,14 @@ VOTE_SET_BITS_CHANNEL = 0x23
 
 GOSSIP_SLEEP = 0.05  # reference peerGossipSleepDuration=100ms; we poll faster
 QUERY_MAJ23_SLEEP = 2.0
+# votes per VoteBatch frame: per-envelope overhead (framing + queue
+# hops + task wakeups) dominates committee-scale gossip, so missing
+# votes ship in batches instead of one frame each
+VOTE_GOSSIP_BATCH = 32
+# have-vote hints are coalesced for this long before one batched
+# broadcast (advisory traffic: a slightly stale hint only risks a
+# duplicate send, which the receiver's VoteSet dedups)
+HAS_VOTE_FLUSH_S = 0.05
 
 
 class ConsensusReactor(Service):
@@ -51,9 +59,17 @@ class ConsensusReactor(Service):
         peer_updates: asyncio.Queue,
         *,
         logger: logging.Logger | None = None,
+        gossip_sleep: float = GOSSIP_SLEEP,
+        stall_refresh_s: float | None = None,
     ):
         super().__init__("cs-reactor", logger)
         self.cs = cs
+        # per-peer gossip poll interval: large router-chaos nets (50-150
+        # validators x degree-k topologies) raise it so thousands of
+        # gossip tasks don't saturate the loop with 20 Hz wakeups
+        self.gossip_sleep = gossip_sleep
+        if stall_refresh_s is not None:
+            self.STALL_REFRESH_S = stall_refresh_s
         self.state_ch = state_ch
         self.data_ch = data_ch
         self.vote_ch = vote_ch
@@ -61,6 +77,7 @@ class ConsensusReactor(Service):
         self.peer_updates = peer_updates
         self.peers: dict[str, PeerState] = {}
         self._peer_tasks: dict[str, list[asyncio.Task]] = {}
+        self._hasvote_buf: list[m.HasVoteMessage] = []
 
     # -- lifecycle -------------------------------------------------------
 
@@ -73,6 +90,7 @@ class ConsensusReactor(Service):
         self.spawn(self._process_data_ch(), name="csr.data")
         self.spawn(self._process_vote_ch(), name="csr.vote")
         self.spawn(self._process_bits_ch(), name="csr.bits")
+        self.spawn(self._flush_has_votes(), name="csr.hasvote")
 
     async def on_stop(self) -> None:
         self.cs.step_hook = None
@@ -104,9 +122,33 @@ class ConsensusReactor(Service):
     def _on_broadcast(self, msg) -> None:
         """Out-of-band broadcasts from the SM: HasVote/NewValidBlock go to
         the state channel; proposal/parts/votes are handled by gossip
-        (but broadcasting them too cuts a round-trip on small nets)."""
-        if isinstance(msg, (m.HasVoteMessage, m.NewValidBlockMessage)):
+        (but broadcasting them too cuts a round-trip on small nets).
+        HasVote is pure advisory traffic and the SM emits one per added
+        vote — O(validators) per height — so it is coalesced and flushed
+        as a single HasVoteBatch frame (`_flush_has_votes`)."""
+        if isinstance(msg, m.HasVoteMessage):
+            if len(self._hasvote_buf) < 8192:  # bounded: hints are lossy
+                self._hasvote_buf.append(msg)
+            return
+        if isinstance(msg, m.NewValidBlockMessage):
             self._send_nowait(self.state_ch, Envelope(STATE_CHANNEL, msg, broadcast=True))
+
+    async def _flush_has_votes(self) -> None:
+        while True:
+            await asyncio.sleep(HAS_VOTE_FLUSH_S)
+            if not self._hasvote_buf:
+                continue
+            buf, self._hasvote_buf = self._hasvote_buf, []
+            for i in range(0, len(buf), m.MAX_BATCH_VOTES):
+                chunk = buf[i : i + m.MAX_BATCH_VOTES]
+                msg = (
+                    chunk[0]
+                    if len(chunk) == 1
+                    else m.HasVoteBatchMessage(tuple(chunk))
+                )
+                self._send_nowait(
+                    self.state_ch, Envelope(STATE_CHANNEL, msg, broadcast=True)
+                )
 
     def _send_nowait(self, ch: Channel, env: Envelope) -> None:
         try:
@@ -170,6 +212,9 @@ class ConsensusReactor(Service):
                     ps.apply_new_valid_block(msg)
                 elif isinstance(msg, m.HasVoteMessage):
                     ps.apply_has_vote(msg)
+                elif isinstance(msg, m.HasVoteBatchMessage):
+                    for entry in msg.entries:
+                        ps.apply_has_vote(entry)
                 elif isinstance(msg, m.VoteSetMaj23Message):
                     await self._handle_vote_set_maj23(env.from_, msg)
             except Exception as e:
@@ -254,15 +299,40 @@ class ConsensusReactor(Service):
     async def _process_vote_ch(self) -> None:
         async for env in self.vote_ch:
             msg = env.message
-            if not isinstance(msg, m.VoteMessage):
+            if isinstance(msg, m.VoteMessage):
+                votes = (msg.vote,)
+            elif isinstance(msg, m.VoteBatchMessage):
+                votes = msg.votes
+            else:
                 continue
-            ps = self.peers.get(env.from_)
-            if ps is not None:
-                v = msg.vote
-                ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
-            ctx = self._start_trace(env)
-            await self.cs.add_vote(msg.vote, env.from_, trace_ctx=ctx)
-            self._finish_receive(ctx, env, "vote")
+            # a decoded-but-garbage vote (corrupt frame that survived the
+            # codec) must cost the PEER, never the channel task: an
+            # uncaught error here would kill csr.vote and wedge the node
+            # for every honest peer too
+            try:
+                ps = self.peers.get(env.from_)
+                first = True
+                for v in votes:
+                    if v.validator_index > m.MAX_WIRE_INDEX:
+                        # same wire bound the HasVote decoder enforces:
+                        # peer bookkeeping must not grow bit arrays from
+                        # an unvalidated index before add_vote rejects it
+                        raise ValueError(
+                            f"vote validator_index {v.validator_index} "
+                            f"exceeds {m.MAX_WIRE_INDEX}"
+                        )
+                    if ps is not None:
+                        ps.set_has_vote(v.height, v.round, v.type, v.validator_index)
+                    ctx = self._start_trace(env)
+                    await self.cs.add_vote(v, env.from_, trace_ctx=ctx)
+                    if first:
+                        # the decode+queue-wait window is per ENVELOPE:
+                        # recording it on every vote of a batch would
+                        # attribute the same wall time up to 32x
+                        self._finish_receive(ctx, env, "vote")
+                        first = False
+            except Exception as e:
+                await self.vote_ch.error(PeerError(env.from_, f"vote msg: {e!r}"))
 
     async def _process_bits_ch(self) -> None:
         async for env in self.bits_ch:
@@ -272,9 +342,25 @@ class ConsensusReactor(Service):
             ps = self.peers.get(env.from_)
             if ps is None:
                 continue
-            # mark all bits the peer claims to have
-            for idx in msg.votes.true_indices():
-                ps.set_has_vote(msg.height, msg.round, msg.type, idx)
+            try:
+                # authoritative reconciliation (reference
+                # handleVoteSetBitsMessage): the reply REPLACES our view
+                # of the peer's votes for the queried round — clearing
+                # has-vote false positives (corrupt-frame HasVotes, sends
+                # the wire ate) that one-way OR bookkeeping keeps forever
+                our_votes = None
+                rs = self.cs.rs
+                if rs.height == msg.height and rs.votes is not None:
+                    vs = (
+                        rs.votes.prevotes(msg.round)
+                        if msg.type == SignedMsgType.PREVOTE
+                        else rs.votes.precommits(msg.round)
+                    )
+                    if vs is not None:
+                        our_votes = vs.bit_array_by_block_id(msg.block_id)
+                ps.apply_vote_set_bits(msg, our_votes)
+            except Exception as e:
+                await self.bits_ch.error(PeerError(env.from_, f"bits msg: {e!r}"))
 
     # -- gossip routines -------------------------------------------------
 
@@ -311,7 +397,7 @@ class ConsensusReactor(Service):
             if not sent and 0 < prs.height < rs.height:
                 sent = self._send_catchup_part(ps)
             if not sent:
-                await asyncio.sleep(GOSSIP_SLEEP)
+                await asyncio.sleep(self.gossip_sleep)
             else:
                 await asyncio.sleep(0)
 
@@ -371,8 +457,30 @@ class ConsensusReactor(Service):
             sent = True
         return sent
 
+    # a peer link with BOTH round states static and nothing to send for
+    # this long is presumed poisoned (a send-marked frame the wire ate:
+    # chaos drop/corruption, or a queue-full drop) — reset the gossip
+    # marks and re-offer. Only a stalled link pays the duplicate cost,
+    # and consecutive refreshes without progress back off exponentially:
+    # at 50-150 validators a refresh re-offers ~2 votes/validator per
+    # link, and a 1s refresh cadence across hundreds of links turns the
+    # cure into a resend storm that starves the very delivery it is
+    # trying to restart (measured: >2k duplicate vote sends/s, loop
+    # saturated, zero progress).
+    STALL_REFRESH_S = 1.0
+    STALL_REFRESH_MAX_BACKOFF = 4  # cap: threshold * 2**4
+
     async def _gossip_votes(self, ps: PeerState) -> None:
-        """Reference gossipVotesRoutine reactor.go:731."""
+        """Reference gossipVotesRoutine reactor.go:731, plus the
+        stall-refresh: the routines mark items delivered at SEND time,
+        so a lossy byte path can leave has-marks for frames that never
+        arrived; when the link is wedged-idle we forget the marks and
+        let receiver-side dedup absorb the re-sends."""
+        last_sig = None
+        idle = 0
+        last_lag_sig = None
+        lag_idle = 0
+        refreshes = 0  # consecutive refreshes with no progress since
         while True:
             rs = self.cs.rs
             prs = ps.prs
@@ -389,10 +497,58 @@ class ConsensusReactor(Service):
                 commit = self.cs.block_store.load_block_commit(prs.height)
                 if commit is not None:
                     sent = self._send_catchup_commit_vote(ps, commit)
-            if not sent:
-                await asyncio.sleep(GOSSIP_SLEEP)
-            else:
+            # stall detection, two distinct wedge shapes:
+            #  * committee wedge — EVERYTHING static (our round state and
+            #    the peer's) and nothing to send: some send-marked frame
+            #    never arrived (chaos drop/corruption/queue-full);
+            #  * starved laggard — the peer sits BEHIND us and doesn't
+            #    move while we have "already sent" catch-up marks: those
+            #    marks were set while the link was partitioned/lossy
+            #    (gossip marks at SEND time, delivery was never
+            #    confirmed), and since WE keep committing, only a
+            #    peer-scoped trigger can notice.
+            sig = (rs.height, rs.round, int(rs.step), prs.height, prs.round, prs.step)
+            lag_sig = (prs.height, prs.round, prs.step)
+            if sent:
+                # sending resets the idle clocks but NOT the backoff: a
+                # refresh's own re-offers count as sends, so resetting
+                # `refreshes` here would re-arm the base cadence after
+                # every refresh and a permanently deaf link would eat
+                # full-commit resends at base rate forever. Only
+                # OBSERVED round-state progress (sig change below)
+                # re-arms fast refresh.
+                idle = lag_idle = 0
+                last_sig, last_lag_sig = sig, lag_sig
                 await asyncio.sleep(0)
+                continue
+            if sig != last_sig:
+                refreshes = 0  # progress somewhere: re-arm fast refresh
+            idle = idle + 1 if sig == last_sig else 0
+            lag_behind = 0 < prs.height < rs.height
+            lag_idle = lag_idle + 1 if (lag_sig == last_lag_sig and lag_behind) else 0
+            last_sig, last_lag_sig = sig, lag_sig
+            threshold = self.STALL_REFRESH_S * (
+                2 ** min(refreshes, self.STALL_REFRESH_MAX_BACKOFF)
+            )
+            stalled = idle * self.gossip_sleep >= threshold
+            starved = lag_idle * self.gossip_sleep >= threshold
+            if stalled or starved:
+                refreshes += 1
+                ps.reset_gossip_marks()
+                # and re-exchange round state: NewRoundStep is only
+                # broadcast on step CHANGES, so one queue-full/chaos
+                # drop leaves the peer's view of us stale forever — and
+                # an idle-wedged committee produces no step changes to
+                # fix it. The peer's own stall-refresh answers with its
+                # HRS, un-staling our prs in the same cycle.
+                self._send_nowait(
+                    self.state_ch,
+                    Envelope(
+                        STATE_CHANNEL, self._new_round_step_msg(), to=ps.peer_id
+                    ),
+                )
+                idle = lag_idle = 0
+            await asyncio.sleep(self.gossip_sleep)
 
     def _gossip_votes_same_height(self, ps: PeerState) -> bool:
         rs = self.cs.rs
@@ -414,12 +570,21 @@ class ConsensusReactor(Service):
         return False
 
     def _pick_send_vote(self, ps: PeerState, votes) -> bool:
-        vote = ps.pick_vote_to_send(votes)
-        if vote is None:
+        """Ship up to VOTE_GOSSIP_BATCH missing votes in one frame
+        (reference PickSendVote sends one; batching is the in-process
+        scale adaptation — per-envelope overhead is the gossip cost)."""
+        picked = ps.pick_votes_to_send(votes, VOTE_GOSSIP_BATCH)
+        if not picked:
             return False
-        ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+        for vote in picked:
+            ps.set_has_vote(vote.height, vote.round, vote.type, vote.validator_index)
+        msg = (
+            m.VoteMessage(picked[0])
+            if len(picked) == 1
+            else m.VoteBatchMessage(tuple(picked))
+        )
         self._send_nowait(
-            self.vote_ch, Envelope(VOTE_CHANNEL, m.VoteMessage(vote), to=ps.peer_id)
+            self.vote_ch, Envelope(VOTE_CHANNEL, msg, to=ps.peer_id)
         )
         return True
 
@@ -430,26 +595,34 @@ class ConsensusReactor(Service):
         prs = ps.prs
         ps.ensure_catchup_commit(prs.height, commit.round, len(commit.signatures))
         have = prs.catchup_commit
-        sent = False
+        pending: list[Vote] = []
         for idx, cs_ in enumerate(commit.signatures):
             if cs_.is_absent() or have.get(idx):
                 continue
-            vote = Vote(
-                type=SignedMsgType.PRECOMMIT,
-                height=commit.height,
-                round=commit.round,
-                block_id=cs_.block_id(commit.block_id),
-                timestamp_ns=cs_.timestamp_ns,
-                validator_address=cs_.validator_address,
-                validator_index=idx,
-                signature=cs_.signature,
+            pending.append(
+                Vote(
+                    type=SignedMsgType.PRECOMMIT,
+                    height=commit.height,
+                    round=commit.round,
+                    block_id=cs_.block_id(commit.block_id),
+                    timestamp_ns=cs_.timestamp_ns,
+                    validator_address=cs_.validator_address,
+                    validator_index=idx,
+                    signature=cs_.signature,
+                )
             )
             have.set(idx, True)
-            self._send_nowait(
-                self.vote_ch, Envelope(VOTE_CHANNEL, m.VoteMessage(vote), to=ps.peer_id)
+        for i in range(0, len(pending), VOTE_GOSSIP_BATCH):
+            chunk = pending[i : i + VOTE_GOSSIP_BATCH]
+            msg = (
+                m.VoteMessage(chunk[0])
+                if len(chunk) == 1
+                else m.VoteBatchMessage(tuple(chunk))
             )
-            sent = True
-        return sent
+            self._send_nowait(
+                self.vote_ch, Envelope(VOTE_CHANNEL, msg, to=ps.peer_id)
+            )
+        return bool(pending)
 
     async def _query_maj23(self, ps: PeerState) -> None:
         """Reference queryMaj23Routine reactor.go:813: periodically tell
@@ -458,12 +631,58 @@ class ConsensusReactor(Service):
             await asyncio.sleep(QUERY_MAJ23_SLEEP)
             rs = self.cs.rs
             prs = ps.prs
-            if rs.votes is None or rs.height != prs.height:
+            if rs.height != prs.height:
+                # catch-up half (reference reactor.go:846): a laggard can
+                # only ADMIT the catch-up precommits `_gossip_votes` sends
+                # it if the stored commit's round is open in its
+                # HeightVoteSet — rounds beyond its round+1 need a peer
+                # maj23 claim (set_peer_maj23). Without this, a peer that
+                # fell behind while the committee decided in a late round
+                # drops every rescue vote and wedges forever.
+                if (
+                    prs.height != 0
+                    and self.cs.block_store.base()
+                    <= prs.height
+                    <= self.cs.block_store.height()
+                ):
+                    commit = self.cs.block_store.load_block_commit(
+                        prs.height
+                    ) or self.cs.block_store.load_seen_commit(prs.height)
+                    if commit is not None:
+                        self._send_nowait(
+                            self.state_ch,
+                            Envelope(
+                                STATE_CHANNEL,
+                                m.VoteSetMaj23Message(
+                                    prs.height,
+                                    commit.round,
+                                    SignedMsgType.PRECOMMIT,
+                                    commit.block_id,
+                                ),
+                                to=ps.peer_id,
+                            ),
+                        )
                 continue
-            for type_, vs in (
-                (SignedMsgType.PREVOTE, rs.votes.prevotes(prs.round)),
-                (SignedMsgType.PRECOMMIT, rs.votes.precommits(prs.round)),
-            ):
+            if rs.votes is None:
+                continue
+            # reference reactor.go:820-846 — claim the majorities we see
+            # in OUR round, the peer's round, and the peer's POL round;
+            # the VoteSetBits replies these trigger reconcile our view of
+            # the peer (apply_vote_set_bits), so a poisoned has-vote mark
+            # heals within one query cycle
+            queries = {(rs.round, SignedMsgType.PREVOTE),
+                       (rs.round, SignedMsgType.PRECOMMIT)}
+            if prs.round >= 0:
+                queries.add((prs.round, SignedMsgType.PREVOTE))
+                queries.add((prs.round, SignedMsgType.PRECOMMIT))
+            if prs.proposal_pol_round >= 0:
+                queries.add((prs.proposal_pol_round, SignedMsgType.PREVOTE))
+            for round_, type_ in sorted(queries):
+                vs = (
+                    rs.votes.prevotes(round_)
+                    if type_ == SignedMsgType.PREVOTE
+                    else rs.votes.precommits(round_)
+                )
                 if vs is None:
                     continue
                 maj = vs.two_thirds_majority()
@@ -472,7 +691,7 @@ class ConsensusReactor(Service):
                         self.state_ch,
                         Envelope(
                             STATE_CHANNEL,
-                            m.VoteSetMaj23Message(rs.height, prs.round, type_, maj),
+                            m.VoteSetMaj23Message(rs.height, round_, type_, maj),
                             to=ps.peer_id,
                         ),
                     )

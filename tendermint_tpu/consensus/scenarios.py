@@ -1,0 +1,497 @@
+"""Declarative chaos scenario sweep over RouterNet.
+
+A `Scenario` names one fault shape — steady per-link rates
+(`ChaosConfig`), a storage fault plan (`ChaosFSConfig`), and a timed
+`Event` script (partitions forming and healing, a peer going gray, a
+node crashing mid-consensus and restarting) — independent of committee
+size and seed, so the SAME scenario runs as a 4-validator tier-1 smoke,
+a 50-validator sweep, and a 150-validator soak (tests/test_routernet.py)
+and as the `bench.py chaos_soak` config.
+
+`run_scenario` drives it: build a RouterNet over real routers +
+ChaosTransport, play the event script, and watch liveness — every node
+must keep committing. The watchdog asserts all-nodes-progress (min
+committed height advances and reaches the target); on a wedge it dumps
+the flight recorder (libs/trace) plus the per-class chaos fault
+counters, per-node heights and round states to disk, then reports a
+structured outcome instead of hanging — the bench contract (bounded,
+structured outcomes; the multichip discipline).
+
+Node references in events are indices into the net (resolved modulo n,
+so `node=-1` is "the last node"); partition groups may use the string
+"rest" for "every node not named elsewhere in the event"."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field, replace
+
+from ..libs.chaos import ChaosConfig, ChaosNetwork
+from ..libs.chaosfs import ChaosFS, ChaosFSConfig
+from .harness import GENESIS_TIME_NS, MS, fast_config
+from .routernet import RouterNet, committee_config
+
+
+@dataclass(frozen=True)
+class Event:
+    """One timed fault transition. `at_s` is scenario time (scaled by
+    the runner's `time_scale` so the same script fits 4-validator and
+    150-validator block cadences)."""
+
+    at_s: float
+    action: str  # partition | oneway | heal | gray | ungray | crash | restart
+    groups: tuple = ()  # partition: tuple of groups (indices or "rest")
+    src: tuple = ()  # oneway: sender group (indices or "rest")
+    dst: tuple = ()  # oneway: receiver group
+    node: int = 0  # gray/ungray/crash/restart target (index mod n)
+    delay_ms: float = 0.0  # gray: fixed per-message delay
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    summary: str
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    events: tuple[Event, ...] = ()
+    fs: ChaosFSConfig | None = None  # per-node storage faults (crash model)
+
+
+# -- the named taxonomy ----------------------------------------------------
+
+SCENARIOS: dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        Scenario(
+            "baseline",
+            "no faults — the control run every other scenario is read against",
+        ),
+        Scenario(
+            "lossy_links",
+            "drops + exponential delay + duplication + reordering on every link",
+            chaos=ChaosConfig(
+                drop_rate=0.05, delay_ms=5.0, duplicate_rate=0.02,
+                reorder_rate=0.02,
+            ),
+        ),
+        Scenario(
+            "corrupt_wire",
+            "seeded byte corruption on the live gossip byte-stream "
+            "(malformed frames cost the sender its connection; redial heals)",
+            chaos=ChaosConfig(corrupt_rate=0.02, delay_ms=2.0),
+        ),
+        Scenario(
+            "asym_partition",
+            "half-open link: node 0 stops RECEIVING while its own votes "
+            "still flow out; heals mid-run — recovery must ride the "
+            "reactor's catch-up gossip",
+            events=(
+                Event(0.8, "oneway", src=("rest",), dst=(0,)),
+                Event(2.4, "heal"),
+            ),
+        ),
+        Scenario(
+            "gray_failure",
+            "one peer goes slow-but-alive (fixed delay tuned near the "
+            "gossip cadence), then recovers",
+            events=(
+                Event(0.5, "gray", node=1, delay_ms=120.0),
+                Event(2.5, "ungray", node=1),
+            ),
+        ),
+        Scenario(
+            "bandwidth_crunch",
+            "per-link leaky-bucket shaping: block parts queue behind "
+            "votes and backlog becomes delivery delay",
+            chaos=ChaosConfig(bandwidth_rate=192.0 * 1024),
+        ),
+        Scenario(
+            "clock_skew",
+            "per-validator wall-clock skew + oscillator drift (timeouts "
+            "fire early/late); the vote-time floor keeps output deterministic",
+            chaos=ChaosConfig(clock_skew_ms=80.0, clock_drift=0.02),
+        ),
+        Scenario(
+            "crash_fs",
+            "chaos-fs crash mid-consensus: a node dies with a torn WAL "
+            "tail, restarts on the same stores, repairs, and catches up "
+            "through catch-up gossip",
+            fs=ChaosFSConfig(torn_write_rate=1.0),
+            events=(
+                Event(1.2, "crash", node=-1),
+                Event(2.0, "restart", node=-1),
+            ),
+        ),
+        Scenario(
+            "full_taxonomy",
+            "everything at once: lossy + corrupt + shaped links, clock "
+            "skew/drift, a gray peer, an asymmetric partition cycle, and "
+            "a chaos-fs crash/restart mid-consensus",
+            chaos=ChaosConfig(
+                drop_rate=0.02, delay_ms=3.0, duplicate_rate=0.01,
+                reorder_rate=0.01, corrupt_rate=0.008,
+                bandwidth_rate=512.0 * 1024, clock_skew_ms=60.0,
+                clock_drift=0.01,
+            ),
+            fs=ChaosFSConfig(torn_write_rate=1.0),
+            events=(
+                Event(0.5, "gray", node=1, delay_ms=100.0),
+                Event(0.8, "oneway", src=("rest",), dst=(0,)),
+                Event(1.2, "crash", node=-1),
+                Event(2.0, "restart", node=-1),
+                Event(2.4, "heal"),
+                Event(2.6, "ungray", node=1),
+            ),
+        ),
+    )
+}
+
+
+@dataclass
+class ScenarioResult:
+    scenario: str
+    seed: int
+    n_vals: int
+    n_full: int
+    target_height: int
+    ok: bool
+    wedged: bool
+    events_applied: list[str]
+    heights: list[int]
+    elapsed_s: float
+    blocks_per_s: float
+    recover_s: float | None  # last fault event -> all nodes past target
+    faults: dict
+    fs_faults: dict
+    error: str = ""
+    dump_path: str = ""
+
+    def as_dict(self) -> dict:
+        return {
+            "scenario": self.scenario,
+            "seed": self.seed,
+            "n_vals": self.n_vals,
+            "n_full": self.n_full,
+            "target_height": self.target_height,
+            "outcome": "ok" if self.ok else ("wedged" if self.wedged else "error"),
+            "events_applied": self.events_applied,
+            "heights": self.heights,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "blocks_per_s": round(self.blocks_per_s, 4),
+            "recover_s": (
+                round(self.recover_s, 3) if self.recover_s is not None else None
+            ),
+            "faults": self.faults,
+            "fs_faults": self.fs_faults,
+            "error": self.error,
+            "dump_path": self.dump_path,
+        }
+
+
+def _resolve_group(group, n: int, named: set[int]) -> set[int]:
+    out: set[int] = set()
+    for g in group:
+        if g == "rest":
+            out |= set(range(n)) - named
+        else:
+            out.add(g % n)
+    return out
+
+
+def _event_indices(ev: Event, n: int) -> set[int]:
+    named: set[int] = set()
+    for group in (*ev.groups, ev.src, ev.dst):
+        for g in group:
+            if g != "rest":
+                named.add(g % n)
+    return named
+
+
+async def _apply_event(ev: Event, net: RouterNet, chaos: ChaosNetwork) -> None:
+    n = net.n
+    named = _event_indices(ev, n)
+    ids = lambda idxs: {net.nodes[i].node_id for i in idxs}  # noqa: E731
+    if ev.action == "partition":
+        chaos.partition(
+            *(ids(_resolve_group(g, n, named)) for g in ev.groups)
+        )
+    elif ev.action == "oneway":
+        chaos.partition_oneway(
+            ids(_resolve_group(ev.src, n, named)),
+            ids(_resolve_group(ev.dst, n, named)),
+        )
+    elif ev.action == "heal":
+        chaos.heal()
+    elif ev.action == "gray":
+        chaos.set_gray(net.nodes[ev.node % n].node_id, ev.delay_ms)
+    elif ev.action == "ungray":
+        chaos.set_peer_config(net.nodes[ev.node % n].node_id, chaos.config)
+    elif ev.action == "crash":
+        await net.crash(ev.node % n)
+    elif ev.action == "restart":
+        await net.restart(ev.node % n)
+    else:
+        raise ValueError(f"unknown scenario event action {ev.action!r}")
+
+
+def _round_states(net: RouterNet) -> list[dict]:
+    out = []
+    for node in net.nodes:
+        cs = node.cs
+        if cs is None:
+            out.append({"index": node.index, "state": "down"})
+            continue
+        out.append(
+            {
+                "index": node.index,
+                "height": cs.rs.height,
+                "round": cs.rs.round,
+                "step": int(cs.rs.step),
+                "committed": node.block_store.height(),
+                "running": bool(cs.is_running),
+            }
+        )
+    return out
+
+
+def _dump_wedge(
+    scenario: Scenario,
+    net: RouterNet,
+    chaos: ChaosNetwork | None,
+    dump_dir: str,
+    detail: dict,
+) -> str:
+    """Auto-dump on wedge: flight recorder ring (when tracing is on)
+    plus a JSON snapshot of per-class chaos fault counters and every
+    node's round state — the post-mortem the 150-validator soak promises
+    (acceptance: any wedge is diagnosable from disk)."""
+    from ..libs import trace
+
+    os.makedirs(dump_dir, exist_ok=True)
+    flight = trace.auto_dump(f"chaos-wedge-{scenario.name}")
+    path = os.path.join(dump_dir, f"chaos-wedge-{scenario.name}.json")
+    payload = {
+        "scenario": scenario.name,
+        "summary": scenario.summary,
+        "faults": dict(chaos.faults) if chaos is not None else {},
+        "fs_faults": {
+            i: dict(fs.faults)
+            for i, fs in net._fs.items()
+            if fs is not None
+        },
+        "nodes": _round_states(net),
+        "flight_dump": flight or "",
+        **detail,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, default=str)
+    return path
+
+
+async def run_scenario(
+    scenario: Scenario | str,
+    *,
+    n_vals: int = 4,
+    n_full: int = 0,
+    target_height: int = 3,
+    seed: int = 1,
+    config=None,
+    degree: int = 8,
+    timeout_s: float = 60.0,
+    stall_s: float = 20.0,
+    time_scale: float = 1.0,
+    gossip_sleep: float | None = None,
+    use_hub: bool = True,
+    dump_dir: str | None = None,
+    base_clock=None,
+) -> ScenarioResult:
+    """One seeded scenario run. Returns a structured result — it does
+    NOT raise on a wedge (`result.ok` / `result.wedged`); the hard
+    `timeout_s` bound means a caller can sweep the whole taxonomy and
+    still terminate."""
+    if isinstance(scenario, str):
+        scenario = SCENARIOS[scenario]
+    if dump_dir is None:
+        dump_dir = os.environ.get("TMTPU_CHAOS_DUMP_DIR") or tempfile.mkdtemp(
+            prefix="chaos-dumps-"
+        )
+    chaos_cfg = replace(scenario.chaos, seed=seed)
+    # events (partitions/gray) need the controller even when every steady
+    # rate is zero
+    chaos = (
+        ChaosNetwork(chaos_cfg)
+        if (chaos_cfg.enabled() or scenario.events)
+        else None
+    )
+    fs_factory = None
+    if scenario.fs is not None:
+        fs_cfg = scenario.fs
+
+        def fs_factory(i: int, _cfg=fs_cfg, _seed=seed):
+            # one ChaosFS per node: a crash must only tear ITS WAL
+            return ChaosFS(replace(_cfg, seed=_seed * 1009 + i))
+
+    if base_clock is None:
+        from ..libs.clock import ManualClock
+
+        # frozen behind genesis: the vote-time floor pins every stamp
+        base_clock = ManualClock(GENESIS_TIME_NS - 500 * MS)
+    if config is None:
+        # small nets: fast multi-round timeouts; committees: storm-sized
+        # timers (see routernet.committee_config — timers only bound the
+        # unhappy path, quorum drives the happy one)
+        config = fast_config() if n_vals <= 16 else committee_config(n_vals)
+    net = RouterNet(
+        n_vals,
+        n_full=n_full,
+        config=config,
+        chaos=chaos,
+        base_clock=base_clock,
+        degree=degree,
+        topo_seed=seed,
+        gossip_sleep=gossip_sleep,
+        use_hub=use_hub,
+        fs_factory=fs_factory,
+    )
+    loop = asyncio.get_running_loop()
+    heights: list[int] = []
+    faults: dict = {}
+    fs_faults: dict = {}
+    ok = wedged = False
+    error = dump_path = ""
+    recover_s: float | None = None
+    t0 = loop.time()
+    t_done = t0
+    try:
+        await net.start()
+    except Exception as e:  # noqa: BLE001 — structured outcome contract
+        # best-effort teardown of the partially-started net: the hub
+        # refcount and any already-running routers/reactors must not
+        # leak into the caller's loop (run_sweep runs more scenarios)
+        await net.stop()
+        return ScenarioResult(
+            scenario=scenario.name, seed=seed, n_vals=n_vals, n_full=n_full,
+            target_height=target_height, ok=False, wedged=False,
+            events_applied=[], heights=net.heights(), elapsed_s=0.0,
+            blocks_per_s=0.0, recover_s=None,
+            faults=dict(chaos.faults) if chaos is not None else {},
+            fs_faults={}, error=f"start failed: {e!r}",
+        )
+    event_err: list[str] = []
+    events_applied: list[str] = []
+    last_event_t = [t0]
+
+    async def drive_events() -> None:
+        for ev in sorted(scenario.events, key=lambda e: e.at_s):
+            await asyncio.sleep(
+                max(0.0, ev.at_s * time_scale - (loop.time() - t0))
+            )
+            try:
+                await _apply_event(ev, net, chaos)
+                events_applied.append(ev.action)
+            except Exception as e:  # noqa: BLE001 — recorded, run continues
+                event_err.append(f"{ev.action}@{ev.at_s}: {e!r}")
+            last_event_t[0] = loop.time()
+
+    events_task = loop.create_task(drive_events(), name="scenario.events")
+    try:
+        # -- liveness watchdog: all nodes must progress ----------------
+        # Completion is gated on the WHOLE event script having fired
+        # plus at least one height of post-event progress: a fast
+        # committee must not "pass" a crash scenario by reaching the
+        # target before the crash happens.
+        deadline = t0 + timeout_s
+        last_min = -1
+        last_progress = loop.time()
+        post_event_target: int | None = (
+            target_height if not scenario.events else None
+        )
+        while True:
+            await asyncio.sleep(0.2)
+            mh = net.min_height()
+            now = loop.time()
+            if mh > last_min:
+                last_min = mh
+                last_progress = now
+            if post_event_target is None and events_task.done():
+                post_event_target = max(target_height, mh + 1)
+            if post_event_target is not None and mh >= post_event_target:
+                ok = True
+                t_done = now
+                break
+            if now > deadline or (now - last_progress) > stall_s * time_scale:
+                wedged = True
+                t_done = now
+                break
+    except Exception as e:  # noqa: BLE001 — structured outcome, not a raise
+        error = repr(e)
+        t_done = loop.time()
+    finally:
+        events_task.cancel()
+        # reap without absorbing our own cancellation
+        await asyncio.gather(events_task, return_exceptions=True)
+        heights = net.heights()
+        faults = dict(chaos.faults) if chaos is not None else {}
+        fs_faults = {
+            str(i): dict(fs.faults)
+            for i, fs in net._fs.items()
+            if fs is not None
+        }
+        if wedged or error:
+            dump_path = _dump_wedge(
+                scenario,
+                net,
+                chaos,
+                dump_dir,
+                {
+                    "seed": seed,
+                    "n_vals": n_vals,
+                    "target_height": target_height,
+                    "elapsed_s": round(t_done - t0, 3),
+                    "event_errors": event_err,
+                    "error": error,
+                },
+            )
+        await net.stop()
+    if event_err and not error:
+        error = "; ".join(event_err)
+    elapsed = max(t_done - t0, 1e-9)
+    if ok and scenario.events:
+        recover_s = max(0.0, t_done - last_event_t[0])
+    # throughput from what was actually COMMITTED net-wide (the min
+    # height), not the requested target: an event-gated run can outrun
+    # target_height, and chaos_soak compares these numbers across rounds
+    committed = min(heights) if heights else 0
+    return ScenarioResult(
+        scenario=scenario.name,
+        seed=seed,
+        n_vals=n_vals,
+        n_full=n_full,
+        target_height=target_height,
+        ok=ok,
+        wedged=wedged,
+        events_applied=events_applied,
+        heights=heights,
+        elapsed_s=elapsed,
+        blocks_per_s=(committed / elapsed) if ok else 0.0,
+        recover_s=recover_s,
+        faults=faults,
+        fs_faults=fs_faults,
+        error=error,
+        dump_path=dump_path,
+    )
+
+
+async def run_sweep(
+    names: list[str] | None = None,
+    **kwargs,
+) -> list[ScenarioResult]:
+    """Run a list of named scenarios sequentially (the full registry by
+    default) with shared runner kwargs; always returns one structured
+    result per scenario."""
+    out = []
+    for name in names or list(SCENARIOS):
+        out.append(await run_scenario(name, **kwargs))
+    return out

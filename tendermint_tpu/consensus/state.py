@@ -443,66 +443,104 @@ class ConsensusState(Service):
             self.config.timeout_commit_ns, self.rs.height, 0, RoundStep.NEW_HEIGHT
         )
 
+    # messages drained per receive wakeup: under a saturated event loop
+    # (150-validator in-process nets) a task gets roughly one wakeup per
+    # loop cycle, so one-message-per-wakeup caps the SM at the loop's
+    # cycle rate regardless of how cheap an apply is — a catching-up
+    # node with a 10k-vote backlog would take minutes to drain it.
+    # Draining a bounded burst per wakeup amortizes the wakeup; order is
+    # untouched (same single consumer, same queue order).
+    RECV_BURST = 64
+
     async def _receive_routine(self) -> None:
         while True:
             item = await self.msg_queue.get()
-            if self._paused:
-                continue
-            try:
-                if item is _TXS_AVAILABLE:
-                    self._handle_txs_available()
-                elif isinstance(item, TimeoutInfo):
-                    self._wal_write(m.encode_wal_message(item), sync=True)
-                    self._handle_timeout(item)
+            await self._process_input(item)
+            for _ in range(self.RECV_BURST - 1):
+                try:
+                    item = self.msg_queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                await self._process_input(item)
+
+    async def _process_input(self, item) -> None:
+        if self._paused:
+            return
+        # WAL-first, OUTSIDE the survive-the-message guard below: a node
+        # that cannot persist its inputs must fail-stop (the WAL crash
+        # model depends on every acted-on input being on disk), so a
+        # write/fsync error here still kills the receive loop. Peer
+        # msgs are buffered writes (group flush); internal msgs are
+        # WAL-synced in _send_internal (reference state.go:782-806).
+        if isinstance(item, TimeoutInfo):
+            self._wal_write(m.encode_wal_message(item), sync=True)
+        elif item is not _TXS_AVAILABLE:
+            self._wal_write(
+                m.encode_wal_message(item.msg, item.peer_id), sync=False
+            )
+        try:
+            if item is _TXS_AVAILABLE:
+                self._handle_txs_available()
+            elif isinstance(item, TimeoutInfo):
+                self._handle_timeout(item)
+            else:
+                ctx = item.trace
+                if ctx is None:
+                    self._handle_msg(item)
                 else:
-                    # peer msgs: buffered write (group flush); internal
-                    # msgs are WAL-synced in _send_internal (reference
-                    # state.go:782-806)
-                    self._wal_write(
-                        m.encode_wal_message(item.msg, item.peer_id), sync=False
-                    )
-                    ctx = item.trace
-                    if ctx is None:
+                    # apply span starts at the reorder release so the
+                    # four ingest stages tile the end-to-end span:
+                    # wait + verify + reorder + apply == msg, exactly
+                    t_apply = ctx.marks.get("release", self.clock.monotonic())
+                    try:
                         self._handle_msg(item)
-                    else:
-                        # apply span starts at the reorder release so the
-                        # four ingest stages tile the end-to-end span:
-                        # wait + verify + reorder + apply == msg, exactly
-                        t_apply = ctx.marks.get("release", self.clock.monotonic())
-                        try:
-                            self._handle_msg(item)
-                        finally:
-                            t_done = self.clock.monotonic()
-                            kind = type(item.msg).__name__
-                            trace.record(
-                                ctx, "consensus", "apply", t_apply, t_done, msg=kind
-                            )
-                            trace.record(
-                                ctx, "consensus", "msg",
-                                ctx.marks.get("submit", ctx.t0), t_done,
-                                msg=kind, peer=item.peer_id, sig_ok=item.sig_ok,
-                            )
-            except ConflictingVoteError as e:
-                self.evidence_pool.report_conflicting_votes(e.existing, e.new)
-                self.logger.info(
-                    "found conflicting vote, sent to evidence pool: %s", e.new
-                )
-            except (VoteSetError, BlockValidationError, ValueError) as e:
-                self.logger.info("dropped invalid consensus input: %r", e)
-            # run async follow-ups scheduled by handlers (off-loop privval
-            # signing, then finalize) until quiescent — a signed own-vote
-            # can trigger transitions that queue more signing; a failure
-            # here must not kill the receive loop
-            try:
-                while (self._sign_jobs or self._finalize_pending) and (
-                    not self._paused
-                ):
-                    await self._drain_signing()
-                    await self._drain_finalize()
-            except Exception as e:
-                self.logger.error(
-                    "finalize failed at height %d: %r", self.rs.height, e
-                )
+                    finally:
+                        t_done = self.clock.monotonic()
+                        kind = type(item.msg).__name__
+                        trace.record(
+                            ctx, "consensus", "apply", t_apply, t_done, msg=kind
+                        )
+                        trace.record(
+                            ctx, "consensus", "msg",
+                            ctx.marks.get("submit", ctx.t0), t_done,
+                            msg=kind, peer=item.peer_id, sig_ok=item.sig_ok,
+                        )
+        except ConflictingVoteError as e:
+            self.evidence_pool.report_conflicting_votes(e.existing, e.new)
+            self.logger.info(
+                "found conflicting vote, sent to evidence pool: %s", e.new
+            )
+        except (VoteSetError, BlockValidationError, ValueError) as e:
+            self.logger.info("dropped invalid consensus input: %r", e)
+        except Exception:  # noqa: BLE001 — the ONE receive task
+            # Any other exception here kills the single receive task
+            # and silently freezes the node: ingest permits drain,
+            # msg_queue fills, and the only symptom is a validator
+            # that stops voting (the router-chaos matrix caught
+            # exactly this as 150-validator stragglers frozen behind
+            # a dead SM). An unexpected input failure is loud but
+            # survivable — fail the MESSAGE, never the machine.
+            self.logger.error(
+                "consensus input failed at h=%d r=%d (dropped): %s",
+                self.rs.height,
+                self.rs.round,
+                type(item).__name__,
+                exc_info=True,
+            )
+        # run async follow-ups scheduled by handlers (off-loop privval
+        # signing, then finalize) until quiescent — a signed own-vote
+        # can trigger transitions that queue more signing; a failure
+        # here must not kill the receive loop
+        try:
+            while (self._sign_jobs or self._finalize_pending) and (
+                not self._paused
+            ):
+                await self._drain_signing()
+                await self._drain_finalize()
+        except Exception as e:
+            self.logger.error(
+                "finalize failed at height %d: %r", self.rs.height, e
+            )
 
     def _wal_write(self, payload: bytes, *, sync: bool) -> None:
         if self.wal is None or self._replay_mode:

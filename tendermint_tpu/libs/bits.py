@@ -5,13 +5,30 @@ from __future__ import annotations
 
 import secrets
 
+# bit offsets set in each possible byte value (true_indices hot loop)
+_BYTE_BITS = tuple(
+    tuple(i for i in range(8) if b >> i & 1) for b in range(256)
+)
+
 
 class BitArray:
     __slots__ = ("size", "_bits")
 
+    # Hard allocation cap. Legitimate arrays track validators (hundreds)
+    # or block parts (thousands); sizes arrive from the WIRE in several
+    # gossip messages (vote-set bits, part-set headers, has-vote growth),
+    # so without a cap one corrupt varint is a multi-GiB bytearray
+    # allocation — a remote memory bomb. Oversize raises ValueError,
+    # which the reactors attribute to the sending peer.
+    MAX_SIZE = 1 << 24
+
     def __init__(self, size: int):
         if size < 0:
             raise ValueError("negative BitArray size")
+        if size > self.MAX_SIZE:
+            raise ValueError(
+                f"BitArray size {size} exceeds MAX_SIZE {self.MAX_SIZE}"
+            )
         self.size = size
         self._bits = bytearray((size + 7) // 8)
 
@@ -88,13 +105,29 @@ class BitArray:
 
     def pick_random(self) -> int | None:
         """Pick a uniformly random set bit index, or None if empty."""
-        ones = [i for i in range(self.size) if self.get(i)]
+        ones = self.true_indices()
         if not ones:
             return None
         return ones[secrets.randbelow(len(ones))]
 
     def true_indices(self) -> list[int]:
-        return [i for i in range(self.size) if self.get(i)]
+        """Set bit indices, byte-at-a-time via a 256-entry offset table.
+        This is the consensus gossip hot loop — every vote-gossip tick
+        diffs vote sets and walks the result, so the naive per-bit
+        `get()` walk (8 calls per byte) dominated committee-scale
+        profiles (24M get() calls in a 90s window at 150 validators)."""
+        out: list[int] = []
+        bits = self._bits
+        byte_bits = _BYTE_BITS
+        for byte_i, b in enumerate(bits):
+            if b:
+                base = byte_i << 3
+                out.extend(base + off for off in byte_bits[b])
+        # wire-decoded arrays (from_bytes) may carry garbage padding
+        # bits beyond `size`; everything else keeps padding clear
+        while out and out[-1] >= self.size:
+            out.pop()
+        return out
 
     def num_true(self) -> int:
         return sum(bin(b).count("1") for b in self._bits)

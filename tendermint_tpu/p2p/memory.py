@@ -36,23 +36,32 @@ class MemoryConnection(Connection):
         await self._send_q.put(("msg", (channel_id, bytes(data))))
 
     async def receive_message(self) -> tuple[int, bytes]:
+        """Single queue await per message. The old implementation raced a
+        fresh (recv_task, closed_task) pair through asyncio.wait for
+        EVERY message — two task objects plus wait/cancel machinery per
+        frame, which showed up as a top cost in 150-validator gossip
+        profiles. Close is now delivered in-band: both the peer's
+        close() and our own push a ("close", None) sentinel into this
+        queue (evicting an undelivered frame if full — the connection is
+        dying anyway), so a blocked receiver always wakes."""
         if self._closed.is_set():
             raise ConnectionClosedError("connection closed")
-        recv = asyncio.create_task(self._recv_q.get())
-        closed = asyncio.create_task(self._closed.wait())
-        done, pending = await asyncio.wait(
-            {recv, closed}, return_when=asyncio.FIRST_COMPLETED
-        )
-        for p in pending:
-            p.cancel()
-        if recv in done:
-            # tmtlint: allow[blocking-in-async] -- recv is in asyncio.wait's done set; result() returns immediately
-            kind, payload = recv.result()
-            if kind == "close":
-                self._closed.set()
-                raise ConnectionClosedError("peer closed")
-            return payload
-        raise ConnectionClosedError("connection closed")
+        kind, payload = await self._recv_q.get()
+        if kind == "close":
+            self._closed.set()
+            raise ConnectionClosedError("peer closed")
+        return payload
+
+    def _push_sentinel(self, q: asyncio.Queue) -> None:
+        while True:
+            try:
+                q.put_nowait(("close", None))
+                return
+            except asyncio.QueueFull:
+                try:
+                    q.get_nowait()  # drop a doomed frame to make room
+                except asyncio.QueueEmpty:
+                    continue
 
     @property
     def remote_addr(self) -> str:
@@ -61,10 +70,9 @@ class MemoryConnection(Connection):
     async def close(self) -> None:
         if not self._closed.is_set():
             self._closed.set()
-            try:
-                self._send_q.put_nowait(("close", None))
-            except asyncio.QueueFull:
-                pass
+            # wake the remote receiver AND our own blocked receive
+            self._push_sentinel(self._send_q)
+            self._push_sentinel(self._recv_q)
 
 
 class MemoryNetwork:

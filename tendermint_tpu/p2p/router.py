@@ -57,8 +57,8 @@ class Channel:
 
 
 class _PeerState:
-    def __init__(self):
-        self.queue: asyncio.PriorityQueue = asyncio.PriorityQueue(maxsize=4096)
+    def __init__(self, queue_size: int = 4096):
+        self.queue: asyncio.PriorityQueue = asyncio.PriorityQueue(maxsize=queue_size)
         self.tasks: list[asyncio.Task] = []
         self.conn: Connection | None = None
 
@@ -72,11 +72,17 @@ class Router(Service):
         transports: list[Transport],
         *,
         logger: logging.Logger | None = None,
+        peer_queue_size: int = 4096,
     ):
         super().__init__("router", logger)
         self.node_info = node_info
         self.priv_key = priv_key
         self.peer_manager = peer_manager
+        # outbound per-peer buffer: committee-scale gossip (50-150
+        # validators) has commit-time storms where a 4096 bound silently
+        # drops NewRoundStep/HasVote and the net pays a stall-refresh
+        # cycle to recover — chaos harnesses size this up
+        self.peer_queue_size = peer_queue_size
         self.transports = {t.PROTOCOL: t for t in transports}
         self.channels: dict[int, Channel] = {}
         self._peers: dict[NodeID, _PeerState] = {}
@@ -92,6 +98,7 @@ class Router(Service):
         priority: int = 5,
         encode: Callable[[object], bytes] = bytes,
         decode: Callable[[bytes], object] = bytes,
+        queue_size: int = 1024,
     ) -> Channel:
         if channel_id in self.channels:
             raise ValueError(f"channel {channel_id:#x} already open")
@@ -101,6 +108,8 @@ class Router(Service):
             priority=priority,
             encode=encode,
             decode=decode,
+            in_q=asyncio.Queue(maxsize=queue_size),
+            out_q=asyncio.Queue(maxsize=queue_size),
         )
         self.channels[channel_id] = ch
         # update advertised channels
@@ -244,7 +253,7 @@ class Router(Service):
         if not self.peer_manager.connected(nid, inbound=inbound):
             await conn.close()
             return
-        peer = _PeerState()
+        peer = _PeerState(self.peer_queue_size)
         peer.conn = conn
         self._peers[nid] = peer
         peer.tasks.append(

@@ -6,12 +6,66 @@ unit-tested without sockets (SURVEY.md §4)."""
 from __future__ import annotations
 
 import asyncio
+import hashlib
 
 from ..crypto import ed25519
 from .memory import MemoryNetwork
 from .peermanager import PeerManager, PeerStatus
 from .router import Router
 from .types import NodeAddress, NodeInfo, node_id_from_pubkey
+
+
+class RouterShell:
+    """The router-backed p2p shell shared by the chaos harnesses
+    (tests/chaos_net.py blocksync nets, consensus/routernet.py consensus
+    nets): deterministic node key, in-memory transport — chaos-wrapped
+    when a `ChaosNetwork` is given — peer manager, and a Router. Callers
+    open their reactor channels on `shell.router` and subscribe peer
+    updates on `shell.peer_manager`.
+
+    Keys are derived from (key_seed, index), so rebuilding a shell with
+    the same coordinates yields the same node id — the in-process analog
+    of a process restart keeping its node key."""
+
+    def __init__(
+        self,
+        memory: MemoryNetwork,
+        index: int,
+        chain_id: str,
+        *,
+        chaos=None,  # libs/chaos.ChaosNetwork — wraps the transport
+        key_seed: str = "router-shell",
+        moniker: str = "",
+        max_connected: int = 64,
+        peer_queue_size: int = 4096,
+    ):
+        self.index = index
+        self.priv_key = ed25519.Ed25519PrivKey(
+            hashlib.sha256(f"tmtpu:{key_seed}:{index}".encode()).digest()
+        )
+        self.node_id = node_id_from_pubkey(self.priv_key.pub_key())
+        self.node_info = NodeInfo(
+            node_id=self.node_id,
+            network=chain_id,
+            moniker=moniker or f"node{index}",
+        )
+        inner = memory.create_transport(self.node_id)
+        self.transport = (
+            chaos.wrap(inner, self.node_id) if chaos is not None else inner
+        )
+        self.peer_manager = PeerManager(
+            self.node_id, max_connected=max_connected
+        )
+        self.router = Router(
+            self.node_info,
+            self.priv_key,
+            self.peer_manager,
+            [self.transport],
+            peer_queue_size=peer_queue_size,
+        )
+
+    def address(self) -> NodeAddress:
+        return NodeAddress(node_id=self.node_id, protocol="memory")
 
 
 class TestNode:

@@ -19,12 +19,9 @@ from tendermint_tpu.blocksync import BLOCKSYNC_CHANNEL
 from tendermint_tpu.blocksync import messages as bsm
 from tendermint_tpu.blocksync.reactor import BlockSyncReactor
 from tendermint_tpu.consensus.replay import Handshaker
-from tendermint_tpu.crypto import ed25519
 from tendermint_tpu.libs.chaos import ChaosConfig, ChaosNetwork
 from tendermint_tpu.p2p.memory import MemoryNetwork
-from tendermint_tpu.p2p.peermanager import PeerManager
-from tendermint_tpu.p2p.router import Router
-from tendermint_tpu.p2p.types import NodeAddress, NodeInfo, node_id_from_pubkey
+from tendermint_tpu.p2p.testing import RouterShell
 from tendermint_tpu.proxy import AppConns
 from tendermint_tpu.state.execution import BlockExecutor
 from tendermint_tpu.state.state import state_from_genesis
@@ -33,21 +30,20 @@ from tendermint_tpu.store.blockstore import BlockStore
 from tendermint_tpu.store.db import MemDB
 
 
-class ChaosNode:
-    """One router + blocksync reactor over a chaos-wrapped transport."""
+class ChaosNode(RouterShell):
+    """One router + blocksync reactor over a chaos-wrapped transport.
+    The p2p shell (key, transport, peer manager, router) is the shared
+    RouterShell — the same wiring consensus/routernet.py uses — with the
+    blocksync channel and stores layered on top."""
 
     def __init__(self, net: "ChaosSyncNet", index: int, chain_id: str):
-        self.index = index
-        self.priv_key = ed25519.Ed25519PrivKey(bytes([0x60 + index]) * 32)
-        self.node_id = node_id_from_pubkey(self.priv_key.pub_key())
-        self.node_info = NodeInfo(
-            node_id=self.node_id, network=chain_id, moniker=f"chaos{index}"
-        )
-        inner = net.memory.create_transport(self.node_id)
-        self.transport = net.chaos.wrap(inner, self.node_id)
-        self.peer_manager = PeerManager(self.node_id, max_connected=64)
-        self.router = Router(
-            self.node_info, self.priv_key, self.peer_manager, [self.transport]
+        super().__init__(
+            net.memory,
+            index,
+            chain_id,
+            chaos=net.chaos,
+            key_seed="chaos-sync",
+            moniker=f"chaos{index}",
         )
         self.channel = self.router.open_channel(
             BLOCKSYNC_CHANNEL,
@@ -60,9 +56,6 @@ class ChaosNode:
         self.app_conns: AppConns | None = None
         self.block_store: BlockStore | None = None
         self.state_store: StateStore | None = None
-
-    def address(self) -> NodeAddress:
-        return NodeAddress(node_id=self.node_id, protocol="memory")
 
 
 class ChaosSyncNet:
