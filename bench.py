@@ -667,6 +667,114 @@ def bench_chaos_soak(sizes: tuple = (4, 50)) -> dict:
     return out
 
 
+def bench_byz_soak(sizes: tuple = (4, 50)) -> dict:
+    """byz_soak config: Byzantine strategies over real routers measured
+    per round — blocks/s under each traitor strategy, time-to-evidence-
+    commit (heights from the committed pair's equivocation to its
+    on-chain commitment), and the cross-node safety auditor's verdict
+    (consensus/byzantine.audit_net), at 4 and 50 validators. BOUNDED,
+    structured outcomes (the multichip/chaos_soak discipline): the
+    scenario engine's liveness watchdog plus an outer asyncio timeout
+    mean a wedge or an escape is a record, never a hang. The 50-row
+    runs a trimmed strategy list with a height-4 target (evidence needs
+    heights of headroom to commit)."""
+    import asyncio
+
+    from tendermint_tpu.consensus import scenarios as sc
+
+    seed = int(os.environ.get("TMTPU_BENCH_BYZ_SEED", "7") or 7)
+    out: dict = {"seed": seed, "runs": []}
+    for n_vals in sizes:
+        small = n_vals <= 8
+        names = (
+            [
+                "byz_equivocation",
+                "byz_equivocation_partition",
+                "byz_amnesia_skew",
+                "byz_withhold",
+                "byz_invalid_sig",
+                "byz_flood_lies",
+                "byz_full_taxonomy",
+            ]
+            if small
+            else [
+                "byz_equivocation",
+                "byz_invalid_sig",
+                "byz_full_taxonomy",
+            ]
+        )
+        timeout_s = 90.0 if small else 600.0
+        for name in names:
+            t0 = time.perf_counter()
+
+            async def one(_name=name, _n=n_vals, _to=timeout_s):
+                return await sc.run_scenario(
+                    _name,
+                    n_vals=_n,
+                    target_height=4,
+                    seed=seed,
+                    timeout_s=_to,
+                    stall_s=30.0 if small else 150.0,
+                    time_scale=1.0 if small else 6.0,
+                    degree=8,
+                    audit_k=3 if small else 6,
+                )
+
+            try:
+                full = asyncio.run(
+                    asyncio.wait_for(one(), timeout_s + 60.0)
+                ).as_dict()
+                audit = full.get("audit") or {}
+                ev_heights = audit.get("evidence_commit_heights") or {}
+                # time-to-evidence-commit: worst lag across traitors
+                # (commit height − the equivocation height the committed
+                # pair attributes — the auditor's promptness anchor)
+                lags = list((audit.get("evidence_lag_heights") or {}).values())
+                tte = max(lags) if lags else None
+                res = {
+                    "scenario": name,
+                    "n_vals": n_vals,
+                    "outcome": full["outcome"],
+                    "blocks_per_s": full["blocks_per_s"],
+                    "elapsed_s": full["elapsed_s"],
+                    "byz_indices": full["byz_indices"],
+                    "byz_action_counts": [
+                        b.get("counts", {}) for b in full["byz_actions"]
+                    ],
+                    "audit_ok": audit.get("ok"),
+                    "evidence_committed": len(ev_heights),
+                    "evidence_commit_heights": ev_heights,
+                    "time_to_evidence_commit_heights": tte,
+                    "conflicting_commits": len(
+                        audit.get("conflicting_commits") or []
+                    ),
+                    "peer_penalties": audit.get("peer_penalties") or {},
+                }
+            except Exception as e:  # noqa: BLE001 — structured outcome
+                res = {
+                    "scenario": name,
+                    "n_vals": n_vals,
+                    "outcome": f"error: {e!r}"[:200],
+                }
+            res["wall_s"] = round(time.perf_counter() - t0, 2)
+            out["runs"].append(res)
+            log(
+                f"byz_soak {n_vals:>3}v {name:<26} "
+                f"{res.get('outcome', '?'):<7} "
+                f"audit={'ok' if res.get('audit_ok') else 'FAIL'} "
+                f"ev={res.get('evidence_committed', 0)} "
+                f"{res.get('blocks_per_s', 0)} blk/s wall={res['wall_s']}s"
+            )
+    ok = [
+        r
+        for r in out["runs"]
+        if r.get("outcome") == "ok" and r.get("audit_ok")
+    ]
+    out["ok_runs"] = len(ok)
+    out["total_runs"] = len(out["runs"])
+    return out
+
+
 def bench_verify_hub(
     n_vals: int, n_submitters: int = 8, per_submitter: int = 200
 ) -> dict:
@@ -1742,6 +1850,22 @@ def main() -> None:
             extra["chaos_soak"] = bench_chaos_soak(soak_vals)
         except Exception as e:  # noqa: BLE001
             log(f"chaos-soak bench failed: {e!r}")
+    # byz_soak runs on BOTH backends, BOUNDED: Byzantine strategies over
+    # real routers — blocks/s per strategy, time-to-evidence-commit,
+    # and the cross-node safety auditor's verdict at 4 and 50
+    # validators. Pure host/event-loop work like chaos_soak.
+    if os.environ.get("TMTPU_BENCH_BYZ_SOAK") != "0":
+        try:
+            byz_vals = tuple(
+                int(v)
+                for v in os.environ.get(
+                    "TMTPU_BENCH_BYZ_VALS", "4,50"
+                ).split(",")
+                if v.strip()
+            )
+            extra["byz_soak"] = bench_byz_soak(byz_vals)
+        except Exception as e:  # noqa: BLE001
+            log(f"byz-soak bench failed: {e!r}")
     # commit_ab runs on BOTH backends: the aggregate-signature A/B —
     # EdDSA-batch vs BLS-aggregate on the same 150-validator chain
     # (commit wire bytes x verify sigs/s x catch-up blocks/s). On CPU

@@ -346,7 +346,10 @@ class LocalNetwork:
                 # does this) so precommits beyond round+1 are admitted
                 if cs.rs.height == h and cs.rs.votes is not None:
                     cs.rs.votes.set_peer_maj23(
-                        commit.round, SignedMsgType.PRECOMMIT, "catchup-relay"
+                        commit.round,
+                        SignedMsgType.PRECOMMIT,
+                        "catchup-relay",
+                        commit.block_id,
                     )
                 # precommits first: +2/3 moves the receiver to COMMIT and
                 # arms a PartSet for the decided block id …

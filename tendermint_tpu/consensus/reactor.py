@@ -46,6 +46,37 @@ VOTE_GOSSIP_BATCH = 32
 # broadcast (advisory traffic: a slightly stale hint only risks a
 # duplicate send, which the receiver's VoteSet dedups)
 HAS_VOTE_FLUSH_S = 0.05
+# default catch-up token-bucket burst (items = votes or block parts):
+# one full commit's worth of votes at committee scale, so a single
+# freshly-healed laggard still catches a whole height per tick while a
+# SUSTAINED lag storm (many laggards, or byzantine peers lying about
+# their height to bait catch-up service) degrades to the refill rate
+CATCHUP_BURST = 4 * 32
+
+
+class _CatchupBucket:
+    """Per-peer token bucket for catch-up service (ROADMAP: straggler
+    catch-up at 150 validators costs the donor 1-3 min of loop share —
+    and consensus/byzantine.py's lying_frames strategy manufactures
+    laggards on purpose). One token = one sent item (a commit vote or a
+    stored block part). Pure function of (rate, burst, now): callers
+    pass the injected clock's monotonic reading, so the bucket is
+    deterministic under test clocks and never reads wall time."""
+
+    def __init__(self, rate: float, burst: int, now: float):
+        self.rate = rate
+        self.burst = max(1, burst)
+        self.tokens = float(self.burst)
+        self.last = now
+
+    def grant(self, want: int, now: float) -> int:
+        self.tokens = min(
+            float(self.burst), self.tokens + max(0.0, now - self.last) * self.rate
+        )
+        self.last = now
+        got = min(want, int(self.tokens))
+        self.tokens -= got
+        return got
 
 
 class ConsensusReactor(Service):
@@ -61,9 +92,21 @@ class ConsensusReactor(Service):
         logger: logging.Logger | None = None,
         gossip_sleep: float = GOSSIP_SLEEP,
         stall_refresh_s: float | None = None,
+        catchup_rate: float | None = None,
+        catchup_burst: int | None = None,
     ):
         super().__init__("cs-reactor", logger)
         self.cs = cs
+        # per-peer catch-up pacing: rate = items/s a single lagging peer
+        # may draw from this node's stores (None = unlimited, the
+        # pre-pacing behavior small nets keep). Bounds the donor's loop
+        # share during lag storms; the laggard's recovery speed then
+        # comes from MANY donors, each serving its bounded slice.
+        self.catchup_rate = catchup_rate
+        self.catchup_burst = (
+            catchup_burst if catchup_burst is not None else CATCHUP_BURST
+        )
+        self._catchup_buckets: dict[str, _CatchupBucket] = {}
         # per-peer gossip poll interval: large router-chaos nets (50-150
         # validators x degree-k topologies) raise it so thousands of
         # gossip tasks don't saturate the loop with 20 Hz wakeups
@@ -194,8 +237,21 @@ class ConsensusReactor(Service):
                 )
             else:
                 self.peers.pop(upd.node_id, None)
+                self._catchup_buckets.pop(upd.node_id, None)
                 for t in self._peer_tasks.pop(upd.node_id, []):
                     t.cancel()
+
+    def _catchup_grant(self, peer_id: str, want: int) -> int:
+        """How many catch-up items (commit votes / stored parts) this
+        peer may be served right now. Unlimited when pacing is off."""
+        if self.catchup_rate is None or want <= 0:
+            return want
+        now = self.cs.clock.monotonic()
+        bucket = self._catchup_buckets.get(peer_id)
+        if bucket is None:
+            bucket = _CatchupBucket(self.catchup_rate, self.catchup_burst, now)
+            self._catchup_buckets[peer_id] = bucket
+        return bucket.grant(want, now)
 
     # -- inbound processing ---------------------------------------------
 
@@ -226,7 +282,7 @@ class ConsensusReactor(Service):
         rs = self.cs.rs
         if rs.height != msg.height or rs.votes is None:
             return
-        rs.votes.set_peer_maj23(msg.round, msg.type, peer_id)
+        rs.votes.set_peer_maj23(msg.round, msg.type, peer_id, msg.block_id)
         vs = (
             rs.votes.prevotes(msg.round)
             if msg.type == SignedMsgType.PREVOTE
@@ -439,12 +495,19 @@ class ConsensusReactor(Service):
             prs.proposal_block_parts_header = (psh.total, psh.hash)
             prs.proposal_block_parts = BitArray(psh.total)
         # batched: send every part the peer is missing in one sweep (a
-        # catching-up peer must outpace live block production)
+        # catching-up peer must outpace live block production) — capped
+        # by the per-peer catch-up bucket so a lag storm cannot turn
+        # this sweep into the donor's whole loop share
+        missing = prs.proposal_block_parts.not_().true_indices()
+        grant = self._catchup_grant(ps.peer_id, len(missing))
         sent = False
-        for idx in prs.proposal_block_parts.not_().true_indices():
+        for idx in missing:
+            if grant <= 0:
+                break
             part = self.cs.block_store.load_block_part(prs.height, idx)
             if part is None:
                 continue
+            grant -= 1
             prs.proposal_block_parts.set(idx, True)
             self._send_nowait(
                 self.data_ch,
@@ -508,7 +571,16 @@ class ConsensusReactor(Service):
             #    confirmed), and since WE keep committing, only a
             #    peer-scoped trigger can notice.
             sig = (rs.height, rs.round, int(rs.step), prs.height, prs.round, prs.step)
-            lag_sig = (prs.height, prs.round, prs.step)
+            # the starved-laggard signature is the peer's HEIGHT alone:
+            # a laggard whose catch-up frames were eaten keeps churning
+            # ROUNDS on its own timeouts (it can never quorum a stale
+            # height by itself), and a (height, round, step) signature
+            # reads that churn as progress — the refresh then never
+            # fires and the mark-poisoned link starves the peer for as
+            # long as the rounds keep turning (surfaced by the byz
+            # full-taxonomy matrix: a healed one-way-partition victim
+            # wedged at its old height while round-cycling)
+            lag_sig = prs.height
             if sent:
                 # sending resets the idle clocks but NOT the backoff: a
                 # refresh's own re-offers count as sends, so resetting
@@ -595,8 +667,21 @@ class ConsensusReactor(Service):
         prs = ps.prs
         ps.ensure_catchup_commit(prs.height, commit.round, len(commit.signatures))
         have = prs.catchup_commit
+        # per-peer pacing: only votes actually granted get their "sent"
+        # mark — an over-budget remainder stays unmarked and ships on a
+        # later tick once the bucket refills
+        budget = self._catchup_grant(
+            ps.peer_id,
+            sum(
+                1
+                for idx, cs_ in enumerate(commit.signatures)
+                if not cs_.is_absent() and not have.get(idx)
+            ),
+        )
         pending: list[Vote] = []
         for idx, cs_ in enumerate(commit.signatures):
+            if len(pending) >= budget:
+                break
             if cs_.is_absent() or have.get(idx):
                 continue
             pending.append(

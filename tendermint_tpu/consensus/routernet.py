@@ -38,8 +38,11 @@ from __future__ import annotations
 import asyncio
 import random
 
+from ..evidence import EVIDENCE_CHANNEL
+from ..evidence.reactor import EvidenceReactor
 from ..p2p.memory import MemoryNetwork
 from ..p2p.testing import RouterShell
+from ..types.evidence import decode_evidence
 from . import messages as m
 from .harness import MS, Node, fast_config, make_genesis
 from .reactor import (
@@ -162,7 +165,19 @@ class RouterNode:
             VOTE_SET_BITS_CHANNEL, name="cs-bits", priority=1,
             encode=m.encode_message, decode=m.decode_message, queue_size=qs,
         )
+        # evidence (0x38): priority 6 — same tier as cs-state (node.py's
+        # choice): accountability traffic must not starve behind block
+        # parts, but never outranks them either. Queue sized like the
+        # consensus channels: evidence is rare, but a committee-scale
+        # commit storm shares the router's send loop, and a dropped
+        # evidence frame costs a whole BROADCAST_SLEEP re-offer cycle.
+        self.ev_ch = r.open_channel(
+            EVIDENCE_CHANNEL, name="evidence", priority=6,
+            encode=lambda ev: ev.encode(), decode=decode_evidence,
+            queue_size=qs,
+        )
         self.reactor: ConsensusReactor | None = None
+        self.ev_reactor: EvidenceReactor | None = None
 
     # convenience mirrors of the inner harness node
     @property
@@ -174,9 +189,12 @@ class RouterNode:
         return self.inner.block_store
 
     async def prepare(self) -> None:
-        """Build the full stack and bring the ROUTER + REACTOR up, but do
-        not start the consensus SM yet — node.py's ordering, so the first
-        proposal isn't broadcast into a hook-less void."""
+        """Build the full stack and bring the ROUTER + REACTORS up, but
+        do not start the consensus SM yet — node.py's ordering, so the
+        first proposal isn't broadcast into a hook-less void. The net's
+        `prepare_hook` (the Byzantine injection seam — see
+        consensus/byzantine.py) runs LAST, after the reactor exists and
+        before any vote is signed."""
         await self.inner.start(start_consensus=False)
         self.reactor = ConsensusReactor(
             self.inner.cs,
@@ -187,9 +205,22 @@ class RouterNode:
             self.shell.peer_manager.subscribe(),
             gossip_sleep=self.net.gossip_sleep,
             stall_refresh_s=self.net.stall_refresh_s,
+            catchup_rate=self.net.catchup_rate,
+            catchup_burst=self.net.catchup_burst,
+        )
+        # the evidence reactor rides the same peer-update feed: pending
+        # DuplicateVoteEvidence gossips over the real (chaos-wrapped)
+        # byte path instead of moving only inside proposed blocks
+        self.ev_reactor = EvidenceReactor(
+            self.inner.evidence_pool,
+            self.ev_ch,
+            self.shell.peer_manager.subscribe(),
         )
         await self.shell.router.start()
         await self.reactor.start()
+        await self.ev_reactor.start()
+        if self.net.prepare_hook is not None:
+            self.net.prepare_hook(self)
 
     async def go(self) -> None:
         await self.inner.cs.start()
@@ -199,6 +230,8 @@ class RouterNode:
         await self.go()
 
     async def stop(self) -> None:
+        if self.ev_reactor is not None:
+            await self.ev_reactor.stop()
         if self.reactor is not None:
             await self.reactor.stop()
         await self.inner.stop()
@@ -226,6 +259,18 @@ class RouterNet:
         stall_refresh_s: float | None = None,
         use_hub: bool = True,
         fs_factory=None,  # index -> libs/chaosfs.ChaosFS | None (per node)
+        app_factory=None,  # index -> ABCI app | None (default KVStore)
+        # called with each RouterNode at the end of prepare() — after
+        # router+reactors are up, before the SM signs anything. The
+        # Byzantine injection seam (consensus/byzantine.byz_prepare_hook)
+        # and the only way a traitor enters a net: RouterNet itself
+        # never imports the strategy layer (byz-containment).
+        prepare_hook=None,
+        # per-peer catch-up pacing (reactor token bucket): None = auto
+        # (unlimited on small nets, bounded at committee scale — a byz
+        # lag-storm must not let laggards eat the donors' loop share)
+        catchup_rate: float | None = None,
+        catchup_burst: int | None = None,
     ):
         self.genesis, self.keys = make_genesis(n_vals, key_type=key_type)
         self.config = config or fast_config()
@@ -251,6 +296,16 @@ class RouterNet:
         self.use_hub = use_hub
         self._hub = None
         self._fs_factory = fs_factory
+        self._app_factory = app_factory
+        self.prepare_hook = prepare_hook
+        # catch-up pacing auto-sizing: small nets stay unlimited (every
+        # existing smoke keeps its latency); committees bound each
+        # lagging peer to a vote budget so N stragglers (or N liars
+        # claiming to lag) cost the donor O(N * rate), not O(N * chain)
+        if catchup_rate is None and self.n > 16:
+            catchup_rate = 64.0 * self.n  # votes/s per lagging peer
+        self.catchup_rate = catchup_rate
+        self.catchup_burst = catchup_burst
         self._fs: dict[int, object] = {}
         self.edges = topology_edges(self.n, degree, topo_seed)
         self.nodes: list[RouterNode] = [
@@ -279,6 +334,8 @@ class RouterNet:
         wal_dir=None,
     ) -> RouterNode:
         key = self.keys[i] if i < len(self.keys) else None
+        if app is None and self._app_factory is not None:
+            app = self._app_factory(i)
         return RouterNode(
             self,
             i,

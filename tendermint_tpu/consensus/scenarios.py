@@ -31,6 +31,7 @@ from dataclasses import dataclass, field, replace
 
 from ..libs.chaos import ChaosConfig, ChaosNetwork
 from ..libs.chaosfs import ChaosFS, ChaosFSConfig
+from .byzantine import ByzConfig, audit_net, byz_prepare_hook
 from .harness import GENESIS_TIME_NS, MS, fast_config
 from .routernet import RouterNet, committee_config
 
@@ -57,6 +58,27 @@ class Scenario:
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     events: tuple[Event, ...] = ()
     fs: ChaosFSConfig | None = None  # per-node storage faults (crash model)
+    # -- the Byzantine axis (consensus/byzantine.py), composable with
+    # every fault class above: (validator index, plan) pairs — indices
+    # resolve mod n_vals at run time, like event node references
+    byz: tuple[tuple[int, ByzConfig], ...] = ()
+    # plan applied to the LAST f = ⌊(n_vals−1)⁄3⌋ validators — the
+    # protocol's full fault budget at any committee size (keeps the
+    # early proposer slots honest so runs make progress from height 1)
+    byz_f_max: ByzConfig | None = None
+    # False for strategies whose detection is probabilistic by design
+    # (split-camp equivocation on a small fast net: the conflicting
+    # pair must cross camps via relay gossip before the height moves
+    # on). Safety and evidence PROMPTNESS always bind; only complete
+    # escape stops being an audit failure.
+    audit_require_evidence: bool = True
+    # storm-sized timeouts at EVERY committee size (committee_config),
+    # not just n>16: f-max traitors + lossy links split round-0 locks,
+    # and re-assembling the POL polka across the committee takes the
+    # gossip-heal latency (stall-refresh cadence ≥1s) — fast_config's
+    # sub-second rounds then churn faster than the polka can converge.
+    # Timers only bound the unhappy path, so clean heights stay fast.
+    storm_timeouts: bool = False
 
 
 # -- the named taxonomy ----------------------------------------------------
@@ -124,6 +146,103 @@ SCENARIOS: dict[str, Scenario] = {
                 Event(2.0, "restart", node=-1),
             ),
         ),
+        # -- the Byzantine axis: validators that LIE, composed with the
+        # network/storage/clock fault classes above. Every run is
+        # audited (consensus/byzantine.audit_net): honest commit + app
+        # hash agreement, DuplicateVoteEvidence accountability within K
+        # heights for every equivocator, peer cost for invalid-sig
+        # gossip.
+        Scenario(
+            "byz_equivocation",
+            "one traitor double-signs prevotes+precommits at every "
+            "height (both votes to every peer): every honest node must "
+            "detect, pool, gossip and COMMIT the DuplicateVoteEvidence",
+            byz=((-1, ByzConfig(("equivocate",))),),
+        ),
+        Scenario(
+            "byz_equivocation_partition",
+            "split-mode equivocation under an asymmetric partition: "
+            "conflicting votes go to disjoint camps, so detection must "
+            "happen where honest relay gossip intersects — while node 0 "
+            "is half-deaf",
+            byz=((-1, ByzConfig(("equivocate",), equiv_split=True)),),
+            events=(
+                Event(0.8, "oneway", src=("rest",), dst=(0,)),
+                Event(2.4, "heal"),
+            ),
+            audit_require_evidence=False,
+        ),
+        Scenario(
+            "byz_amnesia_skew",
+            "a traitor that forgets its lock (amnesia prevotes) on a "
+            "committee with skewed/drifting clocks — the lock rules "
+            "must hold safety on honest nodes alone",
+            chaos=ChaosConfig(clock_skew_ms=80.0, clock_drift=0.02),
+            byz=((-1, ByzConfig(("amnesia", "equivocate"))),),
+        ),
+        Scenario(
+            "byz_withhold",
+            "selective vote/part withholding per peer over lossy links: "
+            "starved peers must heal through honest relay gossip and "
+            "catch-up (paced — the donors' loop share stays bounded)",
+            chaos=ChaosConfig(drop_rate=0.02, delay_ms=3.0),
+            byz=(
+                (
+                    -1,
+                    ByzConfig(
+                        ("withhold_votes", "withhold_parts"),
+                        withhold_frac=0.5,
+                    ),
+                ),
+            ),
+        ),
+        Scenario(
+            "byz_invalid_sig",
+            "invalid-signature gossip: stage-1 ingest disproves the "
+            "forgery and the traitor pays (PeerError → score/ban, "
+            "audited on every honest peer manager)",
+            byz=((-1, ByzConfig(("invalid_sig", "equivocate"))),),
+        ),
+        Scenario(
+            "byz_flood_lies",
+            "future-round vote floods plus lying NewRoundStep/HasVote "
+            "frames: the unwanted-round guard sheds the flood without "
+            "verify spend; VoteSetBits reconciliation + stall-refresh "
+            "heal the poisoned gossip marks; catch-up pacing bounds the "
+            "lag-bait service",
+            byz=((-1, ByzConfig(("future_round_flood", "lying_frames"))),),
+        ),
+        Scenario(
+            "byz_full_taxonomy",
+            "f = ⌊(n−1)/3⌋ traitors equivocating, forgetting locks, "
+            "withholding and forging signatures under network chaos — "
+            "the protocol's entire fault budget, demonstrated live. "
+            "(lying_frames/future_round_flood stay out of the f-max "
+            "mix by design: a traitor lying about its own height makes "
+            "its voting power vanish from every later round, and at "
+            "f-max that parks the committee at EXACTLY the honest "
+            "quorum — Tendermint is still safe but round alignment "
+            "under chaos stops being wall-clock-feasible; those "
+            "strategies run at f=1 in byz_flood_lies instead)",
+            chaos=ChaosConfig(
+                drop_rate=0.02, delay_ms=3.0, duplicate_rate=0.01,
+                reorder_rate=0.01, corrupt_rate=0.008,
+                clock_skew_ms=60.0, clock_drift=0.01,
+            ),
+            byz_f_max=ByzConfig(
+                (
+                    "equivocate",
+                    "amnesia",
+                    "withhold_votes",
+                    "invalid_sig",
+                )
+            ),
+            events=(
+                Event(0.8, "oneway", src=("rest",), dst=(0,)),
+                Event(2.4, "heal"),
+            ),
+            storm_timeouts=True,
+        ),
         Scenario(
             "full_taxonomy",
             "everything at once: lossy + corrupt + shaped links, clock "
@@ -167,6 +286,12 @@ class ScenarioResult:
     fs_faults: dict
     error: str = ""
     dump_path: str = ""
+    # cross-node safety auditor verdict (byzantine.audit_net) — present
+    # for EVERY scenario (agreement checks are byz-independent); the
+    # evidence/penalty checks only bind when traitors were installed
+    audit: dict | None = None
+    byz_indices: list = field(default_factory=list)
+    byz_actions: list = field(default_factory=list)
 
     def as_dict(self) -> dict:
         return {
@@ -187,6 +312,9 @@ class ScenarioResult:
             "fs_faults": self.fs_faults,
             "error": self.error,
             "dump_path": self.dump_path,
+            "audit": self.audit,
+            "byz_indices": self.byz_indices,
+            "byz_actions": self.byz_actions,
         }
 
 
@@ -306,6 +434,7 @@ async def run_scenario(
     use_hub: bool = True,
     dump_dir: str | None = None,
     base_clock=None,
+    audit_k: int = 3,  # heights an equivocator's evidence may take to commit
 ) -> ScenarioResult:
     """One seeded scenario run. Returns a structured result — it does
     NOT raise on a wedge (`result.ok` / `result.wedged`); the hard
@@ -339,10 +468,29 @@ async def run_scenario(
         # frozen behind genesis: the vote-time floor pins every stamp
         base_clock = ManualClock(GENESIS_TIME_NS - 500 * MS)
     if config is None:
-        # small nets: fast multi-round timeouts; committees: storm-sized
-        # timers (see routernet.committee_config — timers only bound the
-        # unhappy path, quorum drives the happy one)
-        config = fast_config() if n_vals <= 16 else committee_config(n_vals)
+        # small nets: fast multi-round timeouts; committees (and
+        # scenarios that declare storm_timeouts — f-max byz runs):
+        # storm-sized timers (see routernet.committee_config — timers
+        # only bound the unhappy path, quorum drives the happy one)
+        config = (
+            fast_config()
+            if n_vals <= 16 and not scenario.storm_timeouts
+            else committee_config(n_vals)
+        )
+    # -- the Byzantine plan: explicit (index, config) pairs plus the
+    # f-max budget; per-traitor seeds derive from the RUN seed so two
+    # same-seed runs produce bit-identical byzantine behavior
+    byz_registry: list = []
+    byz_plan: dict[int, ByzConfig] = {}
+    for idx, bcfg in scenario.byz:
+        i = idx % n_vals
+        byz_plan[i] = replace(bcfg, seed=seed * 1013 + i)
+    if scenario.byz_f_max is not None:
+        f = max(0, (n_vals - 1) // 3)
+        for i in range(n_vals - f, n_vals):
+            byz_plan.setdefault(
+                i, replace(scenario.byz_f_max, seed=seed * 1013 + i)
+            )
     net = RouterNet(
         n_vals,
         n_full=n_full,
@@ -354,6 +502,9 @@ async def run_scenario(
         gossip_sleep=gossip_sleep,
         use_hub=use_hub,
         fs_factory=fs_factory,
+        prepare_hook=(
+            byz_prepare_hook(byz_plan, byz_registry) if byz_plan else None
+        ),
     )
     loop = asyncio.get_running_loop()
     heights: list[int] = []
@@ -382,6 +533,16 @@ async def run_scenario(
     event_err: list[str] = []
     events_applied: list[str] = []
     last_event_t = [t0]
+    # liveness is a guarantee for CORRECT nodes: a traitor can always
+    # wedge itself (e.g. lying_frames under-reports its own height and
+    # starves its own catch-up), so the all-nodes-progress gate and the
+    # throughput figure read the minimum over HONEST nodes only
+    honest_idx = [i for i in range(net.n) if i not in byz_plan] or list(
+        range(net.n)
+    )
+
+    def honest_min() -> int:
+        return min(net.heights()[i] for i in honest_idx)
 
     async def drive_events() -> None:
         for ev in sorted(scenario.events, key=lambda e: e.at_s):
@@ -410,7 +571,7 @@ async def run_scenario(
         )
         while True:
             await asyncio.sleep(0.2)
-            mh = net.min_height()
+            mh = honest_min()
             now = loop.time()
             if mh > last_min:
                 last_min = mh
@@ -439,6 +600,18 @@ async def run_scenario(
             for i, fs in net._fs.items()
             if fs is not None
         }
+        byz_actions = [b.log_summary() for b in byz_registry]
+        # the cross-node safety auditor runs on EVERY scenario outcome —
+        # a wedged net must still never have double-committed
+        try:
+            audit = audit_net(
+                net,
+                byz_registry,
+                k_heights=audit_k,
+                require_evidence=scenario.audit_require_evidence,
+            ).as_dict()
+        except Exception as e:  # noqa: BLE001 — observation must not mask
+            audit = {"ok": False, "notes": [f"audit failed: {e!r}"]}
         if wedged or error:
             dump_path = _dump_wedge(
                 scenario,
@@ -452,6 +625,8 @@ async def run_scenario(
                     "elapsed_s": round(t_done - t0, 3),
                     "event_errors": event_err,
                     "error": error,
+                    "byz": byz_actions,
+                    "audit": audit,
                 },
             )
         await net.stop()
@@ -461,9 +636,10 @@ async def run_scenario(
     if ok and scenario.events:
         recover_s = max(0.0, t_done - last_event_t[0])
     # throughput from what was actually COMMITTED net-wide (the min
-    # height), not the requested target: an event-gated run can outrun
-    # target_height, and chaos_soak compares these numbers across rounds
-    committed = min(heights) if heights else 0
+    # HONEST height), not the requested target: an event-gated run can
+    # outrun target_height, and chaos_soak compares these numbers
+    # across rounds; a self-wedged traitor does not zero the figure
+    committed = min((heights[i] for i in honest_idx), default=0) if heights else 0
     return ScenarioResult(
         scenario=scenario.name,
         seed=seed,
@@ -481,6 +657,9 @@ async def run_scenario(
         fs_faults=fs_faults,
         error=error,
         dump_path=dump_path,
+        audit=audit,
+        byz_indices=sorted(byz_plan),
+        byz_actions=byz_actions,
     )
 
 

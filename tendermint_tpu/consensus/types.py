@@ -171,14 +171,28 @@ class HeightVoteSet:
         return -1, None
 
     def set_peer_maj23(
-        self, round_: int, type_: SignedMsgType, peer_id: str
+        self,
+        round_: int,
+        type_: SignedMsgType,
+        peer_id: str,
+        block_id=None,
     ) -> None:
         """A peer claims a +2/3 majority for (round, type): open that
         round so its votes can be gossiped to us (max 2 catch-up rounds
-        per peer, reference height_vote_set.go:165)."""
+        per peer, reference height_vote_set.go:165). When the claim
+        names a block, the round's vote set records it so conflicting
+        votes for THAT block stay admissible (reference SetPeerMaj23 —
+        the equivocation-vs-catch-up case: an equivocator's twin in a
+        laggard's slot must not block the committed majority forever)."""
         rounds = self._peer_catchup_rounds.setdefault(peer_id, [])
-        if round_ in rounds:
-            return
-        if len(rounds) < 2:
+        if round_ not in rounds and len(rounds) < 2:
             rounds.append(round_)
             self._add_round(round_)
+        if block_id is not None:
+            # record the claim on whichever vote set is reachable — an
+            # already-open round takes it even when this peer's
+            # catch-up budget is spent; claims are bounded PER PEER in
+            # the vote set, so a liar can't crowd out honest donors
+            vs = self._get_vote_set(round_, type_)
+            if vs is not None:
+                vs.set_peer_maj23_block(block_id, peer_id)

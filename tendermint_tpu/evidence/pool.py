@@ -50,6 +50,21 @@ class EvidencePool(EvidencePoolI):
         self._consensus_buffer: list[tuple] = []  # (vote_a, vote_b) pairs
         # cached tip, advanced by update()
         self.state = state_store.load()
+        # pending_evidence is polled by the gossip reactor per peer at
+        # 4 Hz; decoding the whole pending bucket on every poll turned
+        # the first height WITH evidence into an event-loop meltdown at
+        # committee scale (50 nodes × 8 peers × 4 Hz × N decodes/s on
+        # one core — observed as a liveness wedge the moment 16
+        # traitors' evidence became pending). The decoded list is
+        # cached and invalidated by the version stamp every mutation
+        # bumps.
+        self._version = 0
+        self._pending_cache: tuple[int, list] | None = None
+        # one buffered conflict per (H, R, type, validator): gossip
+        # re-delivers an equivocating pair once per re-offer cycle, and
+        # without dedup a committee-scale equivocation flood grows the
+        # buffer (and the per-commit processing pass) without bound
+        self._conflict_keys: set[tuple] = set()
 
     # -- intake ----------------------------------------------------------
 
@@ -65,6 +80,12 @@ class EvidencePool(EvidencePoolI):
         self.logger.info("added evidence height=%d hash=%s", ev.height, ev.hash().hex()[:12])
 
     def report_conflicting_votes(self, vote_a, vote_b) -> None:
+        key = self._conflict_key(vote_a)
+        if key in self._conflict_keys:
+            return
+        if len(self._conflict_keys) >= 1 << 14:
+            self._conflict_keys.clear()  # bounded memory; dups re-dedup above
+        self._conflict_keys.add(key)
         self._consensus_buffer.append((vote_a, vote_b))
 
     # -- verification ----------------------------------------------------
@@ -210,10 +231,19 @@ class EvidencePool(EvidencePoolI):
     # -- proposal / block flow ------------------------------------------
 
     def pending_evidence(self, max_bytes: int) -> tuple[list, int]:
-        out, size = [], 0
+        cache = self._pending_cache
+        if cache is not None and cache[0] == self._version:
+            return self._clip(cache[1], max_bytes)
+        full: list[tuple[object, int]] = []
         for _, raw in self.db.iterate(_PENDING, _PENDING + b"\xff"):
-            ev = decode_evidence(raw)
-            sz = len(raw)
+            full.append((decode_evidence(raw), len(raw)))
+        self._pending_cache = (self._version, full)
+        return self._clip(full, max_bytes)
+
+    @staticmethod
+    def _clip(entries: list, max_bytes: int) -> tuple[list, int]:
+        out, size = [], 0
+        for ev, sz in entries:
             if size + sz > max_bytes:
                 break
             out.append(ev)
@@ -243,6 +273,15 @@ class EvidencePool(EvidencePoolI):
         self._process_consensus_buffer(state)
         self._prune(state)
 
+    @staticmethod
+    def _conflict_key(vote_a) -> tuple:
+        return (
+            vote_a.height,
+            vote_a.round,
+            int(vote_a.type),
+            vote_a.validator_address,
+        )
+
     def _process_consensus_buffer(self, state) -> None:
         buf, self._consensus_buffer = self._consensus_buffer, []
         for vote_a, vote_b in buf:
@@ -266,6 +305,12 @@ class EvidencePool(EvidencePoolI):
                         ev.vote_a.validator_address.hex()[:12],
                     )
             except Exception as e:
+                # forget the dedup key: with it retained, the NEXT
+                # gossip re-delivery of this pair would be silently
+                # dropped at report time and a transient failure here
+                # (store hiccup mid-update) would cost the evidence
+                # forever
+                self._conflict_keys.discard(self._conflict_key(vote_a))
                 self.logger.error("failed to build consensus evidence: %r", e)
 
     def _prune(self, state) -> None:
@@ -281,15 +326,18 @@ class EvidencePool(EvidencePoolI):
                     > params.max_age_duration_ns
                 )
             if age_blocks > params.max_age_num_blocks and expired_time:
+                self._version += 1
                 self.db.delete(key)
                 self.logger.debug("pruned expired evidence at height %d", ev.height)
 
     # -- storage helpers -------------------------------------------------
 
     def _add_pending(self, ev) -> None:
+        self._version += 1
         self.db.set(_key(_PENDING, ev.height, ev.hash()), ev.encode())
 
     def _mark_committed(self, ev) -> None:
+        self._version += 1
         self.db.delete(_key(_PENDING, ev.height, ev.hash()))
         self.db.set(_key(_COMMITTED, ev.height, ev.hash()), b"\x01")
 

@@ -1,6 +1,16 @@
 """Evidence gossip reactor (reference internal/evidence/reactor.go,
 channel 0x38): continuously offer all pending evidence to every peer;
-receivers verify and pool it."""
+receivers verify and pool it.
+
+One wrinkle the live RouterNet wiring surfaced (tests/test_byzantine.py):
+a sender gossips evidence as soon as it verifies locally, but the
+receiver may not have COMMITTED the evidence height yet — the pool then
+raises "evidence from the future". That is honest-vs-honest timing, not
+a protocol violation, and a PeerError here would disconnect a correct
+peer (the router evicts on every channel error) while the sender's
+`sent` mark means the evidence is never re-offered. Future evidence is
+therefore parked in a small bounded buffer and re-verified as this
+node's tip advances; only genuinely invalid evidence costs the peer."""
 
 from __future__ import annotations
 
@@ -16,6 +26,17 @@ from . import EVIDENCE_CHANNEL
 from .pool import EvidenceError, EvidencePool
 
 BROADCAST_SLEEP = 0.25
+#: parked future-evidence bound: DuplicateVoteEvidence is ~300 bytes,
+#: and anything beyond a committee's worth of simultaneous traitors is
+#: a flood, not a race
+MAX_PARKED = 256
+#: heights ahead of our tip we will park for. Honest peers gossip only
+#: VERIFIED pending evidence, which sits at most their own tip — a
+#: claim far past any live peer's height is junk that would otherwise
+#: squat in the bounded park forever (it never stops being "future").
+#: Deep laggards lose nothing: evidence beyond this window is already
+#: committed ON CHAIN by the time they catch up that far.
+PARK_WINDOW = 256
 
 
 class EvidenceReactor(Service):
@@ -33,10 +54,14 @@ class EvidenceReactor(Service):
         self.peer_updates = peer_updates
         self._peer_tasks: dict[str, asyncio.Task] = {}
         self._sent: dict[str, set[bytes]] = {}
+        # hash -> evidence parked because our tip hasn't reached its
+        # height yet; retried as the pool's state advances
+        self._parked: dict[bytes, object] = {}
 
     async def on_start(self) -> None:
         self.spawn(self._process_peer_updates(), name="evr.peers")
         self.spawn(self._process_inbound(), name="evr.in")
+        self.spawn(self._retry_parked(), name="evr.retry")
 
     async def on_stop(self) -> None:
         for t in self._peer_tasks.values():
@@ -58,15 +83,49 @@ class EvidenceReactor(Service):
                     t.cancel()
                 self._sent.pop(upd.node_id, None)
 
+    def _is_future(self, ev) -> bool:
+        state = self.pool.state
+        return state is not None and ev.height > state.last_block_height
+
     async def _process_inbound(self) -> None:
         async for env in self.channel:
             try:
                 ev = decode_evidence(env.message) if isinstance(env.message, bytes) else env.message
+                if self._is_future(ev):
+                    tip = self.pool.state.last_block_height
+                    if (
+                        ev.height <= tip + PARK_WINDOW
+                        and len(self._parked) < MAX_PARKED
+                    ):
+                        self._parked[ev.hash()] = ev
+                    # beyond the window (or park full): drop silently —
+                    # unverifiable now, and if genuine it reaches us
+                    # committed in a block anyway
+                    continue
                 self.pool.add_evidence(ev)
             except EvidenceError as e:
                 await self.channel.error(PeerError(env.from_, f"bad evidence: {e}"))
             except Exception as e:
                 await self.channel.error(PeerError(env.from_, f"evidence: {e!r}"))
+
+    async def _retry_parked(self) -> None:
+        """Re-verify parked future evidence once our tip has advanced.
+        Invalid evidence found here is silently dropped — the peer that
+        sent it was plausible at the time; the pool's own verify keeps
+        the chain safe either way."""
+        while True:
+            await asyncio.sleep(BROADCAST_SLEEP)
+            if not self._parked:
+                continue
+            ready = [
+                h for h, ev in self._parked.items() if not self._is_future(ev)
+            ]
+            for h in ready:
+                ev = self._parked.pop(h)
+                try:
+                    self.pool.add_evidence(ev)
+                except Exception as e:  # noqa: BLE001 — best-effort retry
+                    self.logger.info("parked evidence rejected: %r", e)
 
     async def _broadcast_to(self, peer_id: str) -> None:
         sent = self._sent[peer_id]

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import random
 from dataclasses import dataclass, field
 
@@ -472,6 +473,11 @@ class Node(Service):
                 self.consensus.ingest.max_inflight,
                 "on" if self.verify_hub is not None else "off",
             )
+        # per-peer catch-up pacing (reactor token bucket): bounds the
+        # loop share a single lagging (or lying — see the byzantine
+        # lying_frames strategy) peer can draw as catch-up service.
+        # Unset = unlimited, the historical behavior.
+        catchup_rate_env = os.environ.get("TMTPU_CATCHUP_RATE", "")
         self.cs_reactor = ConsensusReactor(
             self.consensus,
             self.state_ch,
@@ -479,6 +485,7 @@ class Node(Service):
             self.vote_ch,
             self.bits_ch,
             self.peer_manager.subscribe(),
+            catchup_rate=float(catchup_rate_env) if catchup_rate_env else None,
         )
         self.mempool_reactor = MempoolReactor(
             self.mempool,

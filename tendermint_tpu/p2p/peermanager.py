@@ -248,6 +248,14 @@ class PeerManager:
         info = self._peers.get(node_id)
         return info is not None and time.monotonic() < info.banned_until
 
+    def peer_score(self, node_id: NodeID) -> int | None:
+        """Current reputation score for a known peer (None if unknown).
+        Read-only observation surface: the chaos/byzantine auditors
+        assert that protocol violations actually COST the violator
+        (errored()/ban paths above) without reaching into _peers."""
+        info = self._peers.get(node_id)
+        return info.score if info is not None else None
+
     def evict_candidate(self) -> NodeID | None:
         """Lowest-score connected peer when over capacity."""
         if self.num_connected() <= self.max_connected:

@@ -9,6 +9,7 @@ from . import (
     async_rules,
     chokepoint_rules,
     clock_rules,
+    containment_rules,
     nondeterminism_rules,
     trace_rules,
 )
@@ -17,6 +18,7 @@ ALL_RULES = (
     *async_rules.RULES,
     *chokepoint_rules.RULES,
     *clock_rules.RULES,
+    *containment_rules.RULES,
     *nondeterminism_rules.RULES,
     *trace_rules.RULES,
 )

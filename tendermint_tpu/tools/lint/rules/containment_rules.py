@@ -1,0 +1,94 @@
+"""Containment analyzers — modules that must be structurally
+unreachable from production wiring.
+
+byz-containment: `consensus/byzantine.py` is the Byzantine
+fault-injection layer — a signer with NO double-sign guard plus a
+reactor send path that equivocates, withholds and lies on the wire. It
+exists so chaos runs can prove the protocol survives traitors; a node
+that IMPORTS it is one bad refactor away from being one. The rule pins
+the import graph: only the scenario harness (consensus/scenarios.py)
+and the module itself may name it, so `node.py`/`cli.py` can never
+reach it transitively (tests/test_byzantine.py asserts the transitive
+half on the real import graph)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..framework import FileContext, Finding, Rule
+
+#: the quarantined module, as a dotted-path suffix
+_BYZ_SUFFIX = "consensus.byzantine"
+
+
+class ByzContainment(Rule):
+    id = "byz-containment"
+    doc = (
+        "consensus/byzantine (the traitor strategy layer: unguarded "
+        "double-signing + a lying reactor send path) may only be "
+        "imported by the scenario harness and tests — production "
+        "wiring must be structurally unable to reach it"
+    )
+    scope = ("tendermint_tpu/",)
+    profiles = ("node",)
+
+    ALLOWED = (
+        "tendermint_tpu/consensus/byzantine.py",
+        "tendermint_tpu/consensus/scenarios.py",
+    )
+
+    def _package(self, rel: str) -> list[str]:
+        """Dotted package path of the FILE's package (for resolving
+        relative imports): tendermint_tpu/consensus/x.py ->
+        ["tendermint_tpu", "consensus"]."""
+        parts = rel.split("/")
+        return parts[:-1]
+
+    def _resolve_from(self, ctx: FileContext, node: ast.ImportFrom) -> list[str]:
+        """Absolute dotted module paths an ImportFrom can bind:
+        the module itself plus each `module.name` (a submodule import
+        like `from .consensus import byzantine` binds a module whose
+        path only shows up through the name)."""
+        if node.level == 0:
+            base = node.module or ""
+        else:
+            pkg = self._package(ctx.rel)
+            # level 1 = current package, each extra level pops one
+            up = node.level - 1
+            anchor = pkg[: len(pkg) - up] if up else pkg
+            base = ".".join(anchor)
+            if node.module:
+                base = f"{base}.{node.module}" if base else node.module
+        out = [base] if base else []
+        for a in node.names:
+            out.append(f"{base}.{a.name}" if base else a.name)
+        return out
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        if ctx.rel in self.ALLOWED:
+            return
+        for node in ast.walk(ctx.tree):
+            hit = None
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.endswith(_BYZ_SUFFIX) or a.name == "byzantine":
+                        hit = a.name
+                        break
+            elif isinstance(node, ast.ImportFrom):
+                for mod in self._resolve_from(ctx, node):
+                    if mod.endswith(_BYZ_SUFFIX):
+                        hit = mod
+                        break
+            if hit is not None:
+                yield ctx.finding(
+                    self.id,
+                    node,
+                    f"import of {hit!r}: the Byzantine strategy layer is "
+                    "quarantined to the scenario harness and tests — "
+                    "production code must never be able to double-sign "
+                    "or lie on the wire",
+                )
+
+
+RULES = (ByzContainment(),)

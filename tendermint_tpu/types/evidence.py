@@ -50,16 +50,29 @@ class DuplicateVoteEvidence:
         return self.vote_a.height
 
     def hash(self) -> bytes:
-        return sha256(self.encode())
+        # memoized: the evidence gossip reactor hashes every pending
+        # item per peer per broadcast tick (4 Hz) — recomputing
+        # encode+sha256 each time is measurable at committee scale.
+        # Safe on a frozen dataclass: the fields can never change.
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = sha256(self.encode())
+            object.__setattr__(self, "_hash", h)
+        return h
 
     def encode(self) -> bytes:
-        out = pe.varint_field(1, self.TYPE)
-        out += pe.message_field(2, self.vote_a.encode())
-        out += pe.message_field(3, self.vote_b.encode())
-        out += pe.varint_field(4, self.total_voting_power)
-        out += pe.varint_field(5, self.validator_power)
-        out += pe.message_field(6, pe.varint_field(1, self.timestamp_ns))
-        return out
+        enc = self.__dict__.get("_enc")
+        if enc is None:
+            enc = (
+                pe.varint_field(1, self.TYPE)
+                + pe.message_field(2, self.vote_a.encode())
+                + pe.message_field(3, self.vote_b.encode())
+                + pe.varint_field(4, self.total_voting_power)
+                + pe.varint_field(5, self.validator_power)
+                + pe.message_field(6, pe.varint_field(1, self.timestamp_ns))
+            )
+            object.__setattr__(self, "_enc", enc)
+        return enc
 
     @classmethod
     def decode_fields(cls, r: pe.Reader) -> "DuplicateVoteEvidence":

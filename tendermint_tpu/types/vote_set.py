@@ -48,6 +48,16 @@ class VoteSet:
         self._by_block: dict[bytes, int] = {}  # block key -> tallied power
         self._block_votes: dict[bytes, BitArray] = {}
         self.maj23: BlockID | None = None
+        # peer-claimed +2/3 blocks (reference SetPeerMaj23): conflicting
+        # votes for a CLAIMED block stay admissible, so a catching-up
+        # node can still assemble the committed majority when an
+        # equivocator's twin got tallied first and occupies the slot —
+        # without this, one reordered twin wedges the laggard forever
+        # (it re-rejects the committed majority's real vote as a
+        # conflict on every catch-up re-serve).
+        self._peer_maj23_blocks: dict[bytes, BlockID] = {}
+        self._maj23_claims_by_peer: dict[str, set[bytes]] = {}
+        self._maj23_votes: dict[bytes, dict[int, Vote]] = {}
 
     def size(self) -> int:
         return len(self.val_set)
@@ -84,6 +94,11 @@ class VoteSet:
         if existing is not None:
             if existing.block_id == vote.block_id:
                 return False  # duplicate, not an error
+            key = vote.block_id.key()
+            if key in self._peer_maj23_blocks:
+                return self._add_conflicting_maj23_vote(
+                    vote, idx, val, key, verified
+                )
             raise ConflictingVoteError(existing, vote)
 
         if not verified and not vote.verify(self.chain_id, val.pub_key):
@@ -96,9 +111,73 @@ class VoteSet:
         self._by_block[key] = self._by_block.get(key, 0) + val.voting_power
         ba = self._block_votes.setdefault(key, BitArray(len(self.val_set)))
         ba.set(idx, True)
+        self._maybe_cross_maj23(key, vote.block_id)
+        return True
+
+    def _maybe_cross_maj23(self, key: bytes, block_id: BlockID) -> None:
+        """Single place +2/3 crossing is decided — BOTH add paths call
+        it, so conflict-admitted bucket votes are adopted into the
+        canonical slots no matter which vote tipped the tally over.
+        (Adopting only inside the conflict path left make_commit
+        holding twins — an under-quorum commit — whenever the crossing
+        vote arrived through the normal path.)"""
+        if self.maj23 is not None:
+            return
         total = self.val_set.total_voting_power()
-        if self.maj23 is None and self._by_block[key] * 3 > total * 2:
-            self.maj23 = vote.block_id
+        if self._by_block.get(key, 0) * 3 <= total * 2:
+            return
+        self.maj23 = block_id
+        for i, v in self._maj23_votes.get(key, {}).items():
+            cur = self.votes[i]
+            if cur is not None and cur.block_id != block_id:
+                self.votes[i] = v
+
+    def set_peer_maj23_block(
+        self, block_id: BlockID | None, peer_id: str = ""
+    ) -> None:
+        """A peer claims +2/3 voted `block_id` (reference vote_set.go
+        SetPeerMaj23): record the block so conflicting votes for it
+        become admissible (see `_add_conflicting_maj23_vote`). Bounded
+        PER PEER (reference keys claims by peer): a lying peer can burn
+        only its own two slots — it cannot exhaust a shared table and
+        crowd out an honest donor's claim for the real committed block.
+        A claim changes nothing until +2/3 of real signatures arrive."""
+        if block_id is None or block_id.is_nil():
+            return
+        key = block_id.key()
+        if key in self._peer_maj23_blocks:
+            return
+        claims = self._maj23_claims_by_peer.setdefault(peer_id, set())
+        if len(claims) >= 2:
+            return
+        claims.add(key)
+        self._peer_maj23_blocks[key] = block_id
+
+    def _add_conflicting_maj23_vote(
+        self, vote: Vote, idx: int, val, key: bytes, verified: bool
+    ) -> bool:
+        """Admit a conflicting vote for a peer-claimed +2/3 block
+        (reference vote_set.go votesByBlock): the vote counts toward
+        THAT block's tally only — the canonical slot keeps its first
+        vote — and when the claimed block actually crosses +2/3 the
+        canonical slots adopt its votes, so `make_commit` materializes
+        the real committed majority, not the equivocator's twins.
+
+        The (existing, vote) pair is NOT re-raised here: the node is
+        rescuing itself with already-gossiped votes, and every node
+        that tallied the pair in the other order produced the
+        DuplicateVoteEvidence through the normal conflict path."""
+        bucket = self._maj23_votes.setdefault(key, {})
+        if idx in bucket:
+            return False  # same conflicting vote again: plain duplicate
+        if not verified and not vote.verify(self.chain_id, val.pub_key):
+            raise VoteSetError(f"invalid signature from validator {idx}")
+        bucket[idx] = vote
+        ba = self._block_votes.setdefault(key, BitArray(len(self.val_set)))
+        if not ba.get(idx):
+            ba.set(idx, True)
+            self._by_block[key] = self._by_block.get(key, 0) + val.voting_power
+        self._maybe_cross_maj23(key, self._peer_maj23_blocks[key])
         return True
 
     def get_vote(self, idx: int) -> Vote | None:

@@ -1,0 +1,853 @@
+"""ByzNet — Byzantine validator injection over real routers.
+
+The "B" in BFT, demonstrated live: a traitor built from
+`consensus/byzantine.py` rides the real `p2p.Router` byte path
+(RouterNet, PR 11), honest nodes detect its equivocation, pool the
+DuplicateVoteEvidence, gossip it over the evidence channel, COMMIT it
+on chain, and surface it to the app through BeginBlock misbehavior —
+while the cross-node safety auditor proves no two honest nodes ever
+disagreed and the traitor paid for its forgeries.
+
+Determinism construction for the pinned lifecycle test: frozen
+ManualClock behind genesis (vote-time floor pins all stamps), generous
+timeouts (commit round pinned at 0), the traitor is the HEIGHT-1
+PROPOSER (so the height-2 proposer — the one that includes the
+evidence — is honest and detected the equivocation locally), it
+equivocates prevotes in ``both`` mode (every honest node receives the
+conflicting pair back-to-back on a FIFO link → deterministic local
+detection) and withholds ALL its precommits (every commit then needs
+exactly the three honest precommits → pinned signer set). Two
+same-seed runs produce bit-identical block bytes AND evidence bytes.
+
+Tier-1 carries the 4-validator tests under explicit wall-time budgets
+(the tmtlint budget-gate pattern); the 50-validator byz sweep and the
+f-max soak are slow-marked."""
+
+import asyncio
+import json
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tendermint_tpu.abci.kvstore import KVStoreApp
+from tendermint_tpu.consensus import scenarios as sc
+from tendermint_tpu.consensus.byzantine import (
+    ByzConfig,
+    ByzantineNode,
+    _decide,
+    _fabricated_block_id,
+    audit_net,
+    byz_prepare_hook,
+    committed_duplicate_vote_evidence,
+)
+from tendermint_tpu.consensus.harness import GENESIS_TIME_NS, make_genesis
+from tendermint_tpu.consensus.reactor import ConsensusReactor, _CatchupBucket
+from tendermint_tpu.consensus.routernet import RouterNet
+from tendermint_tpu.evidence.pool import EvidencePool
+from tendermint_tpu.libs.chaos import ChaosConfig, ChaosNetwork
+from tendermint_tpu.libs.clock import ManualClock
+from tendermint_tpu.state.state import state_from_genesis
+from tendermint_tpu.types.evidence import DuplicateVoteEvidence
+from tendermint_tpu.types.keys import SignedMsgType
+
+MS = 1_000_000
+
+# the safety criterion: an equivocator's evidence must be ON CHAIN
+# within K heights of the double-sign
+K_HEIGHTS = 3
+
+
+def frozen_clock() -> ManualClock:
+    return ManualClock(GENESIS_TIME_NS - 500 * MS)
+
+
+def generous_config():
+    from tendermint_tpu.config import ConsensusConfig
+
+    return ConsensusConfig(
+        timeout_propose_ns=3000 * MS,
+        timeout_propose_delta_ns=500 * MS,
+        timeout_prevote_ns=2000 * MS,
+        timeout_prevote_delta_ns=500 * MS,
+        timeout_precommit_ns=2000 * MS,
+        timeout_precommit_delta_ns=500 * MS,
+        timeout_commit_ns=80 * MS,
+        skip_timeout_commit=True,
+    )
+
+
+def height1_proposer_index(n_vals: int) -> int:
+    """The validator index proposing height 1 in a RouterNet(n_vals)
+    net — RouterNet derives the same genesis via make_genesis."""
+    genesis, keys = make_genesis(n_vals)
+    addr = state_from_genesis(genesis).validators.get_proposer().address
+    return next(
+        i for i, k in enumerate(keys) if k.pub_key().address() == addr
+    )
+
+
+class RecordingApp(KVStoreApp):
+    """KVStore plus a tape of BeginBlock misbehavior reports — the ABCI
+    surface the whole evidence lifecycle terminates at."""
+
+    def __init__(self):
+        super().__init__()
+        self.misbehavior: list[tuple[int, tuple]] = []
+
+    def begin_block(self, req):
+        if req.byzantine_validators:
+            self.misbehavior.append(
+                (req.header.height, tuple(req.byzantine_validators))
+            )
+        return super().begin_block(req)
+
+
+class TestUnits:
+    def test_unknown_strategy_rejected(self):
+        with pytest.raises(ValueError, match="unknown byzantine"):
+            ByzConfig(("equivocate", "bribe_the_app"))
+
+    def test_decisions_are_seed_deterministic(self):
+        a = [_decide(7, "camp", 1, 0, "peer") for _ in range(3)]
+        assert len(set(a)) == 1
+        assert _decide(7, "camp", 1, 0, "peer") != _decide(8, "camp", 1, 0, "peer")
+        assert 0.0 <= a[0] < 1.0
+
+    def test_fabricated_block_id_is_complete_and_stable(self):
+        b1 = _fabricated_block_id(3, "equiv", 1, 0, 2)
+        b2 = _fabricated_block_id(3, "equiv", 1, 0, 2)
+        assert b1 == b2 and b1.is_complete()
+        assert b1 != _fabricated_block_id(4, "equiv", 1, 0, 2)
+
+    def test_catchup_bucket_grant_semantics(self):
+        b = _CatchupBucket(rate=10.0, burst=5, now=100.0)
+        assert b.grant(3, 100.0) == 3  # burst available immediately
+        assert b.grant(5, 100.0) == 2  # drained to the burst cap
+        assert b.grant(5, 100.0) == 0  # empty, no time elapsed
+        assert b.grant(5, 100.5) == 5  # 0.5s * 10/s = 5 tokens refilled
+        assert b.grant(100, 200.0) == 5  # refill is capped at burst
+
+    def test_byz_scenarios_registered_and_composable(self):
+        names = set(sc.SCENARIOS)
+        assert {
+            "byz_equivocation",
+            "byz_equivocation_partition",
+            "byz_amnesia_skew",
+            "byz_withhold",
+            "byz_invalid_sig",
+            "byz_flood_lies",
+            "byz_full_taxonomy",
+        } <= names
+        # the byz axis composes with the existing fault taxonomy
+        part = sc.SCENARIOS["byz_equivocation_partition"]
+        assert part.byz and {e.action for e in part.events} >= {"oneway", "heal"}
+        skew = sc.SCENARIOS["byz_amnesia_skew"]
+        assert skew.byz and skew.chaos.clock_skew_ms > 0
+        full = sc.SCENARIOS["byz_full_taxonomy"]
+        assert full.byz_f_max is not None
+        assert full.chaos.corrupt_rate > 0 and full.chaos.clock_skew_ms > 0
+
+
+class TestDoubleSignLifecycle:
+    @pytest.mark.asyncio
+    async def test_full_lifecycle_bit_identical_across_same_seed_runs(self):
+        """THE acceptance test: equivocating vote pair observed →
+        DuplicateVoteEvidence in honest pools → gossiped on the
+        evidence channel → committed in a block within K heights →
+        surfaced to the app via BeginBlock misbehavior — and two
+        same-seed runs produce bit-identical block bytes AND evidence
+        bytes, over real routers."""
+        t0 = time.perf_counter()
+        n, target = 4, 4
+        byz_idx = height1_proposer_index(n)
+        observer = (byz_idx + 1) % n
+        byz_addr = make_genesis(n)[1][byz_idx].pub_key().address()
+
+        async def one_run(seed: int):
+            plan = {
+                byz_idx: ByzConfig(
+                    ("equivocate", "withhold_precommits"),
+                    seed=seed,
+                    equiv_heights=(1,),
+                    equiv_types=(SignedMsgType.PREVOTE,),
+                )
+            }
+            registry: list = []
+            apps: dict[int, RecordingApp] = {}
+
+            def app_factory(i):
+                if i == observer:
+                    apps[i] = RecordingApp()
+                    return apps[i]
+                return None
+
+            gossiped = []
+            orig_add = EvidencePool.add_evidence
+
+            def counting_add(self, ev, _orig=orig_add):
+                gossiped.append(type(ev).__name__)
+                return _orig(self, ev)
+
+            EvidencePool.add_evidence = counting_add
+            net = RouterNet(
+                n,
+                config=generous_config(),
+                base_clock=frozen_clock(),
+                prepare_hook=byz_prepare_hook(plan, registry),
+                app_factory=app_factory,
+            )
+            try:
+                await net.start()
+                await net.wait_for_height(target, 90)
+                # pools on every honest node saw the pair
+                rep = audit_net(net, registry, k_heights=K_HEIGHTS)
+                evidence = committed_duplicate_vote_evidence(
+                    net.nodes[observer]
+                )
+                return {
+                    "blocks": net.block_fingerprints(target, node=observer),
+                    "apps": net.app_hash_chain(target, node=observer),
+                    "audit": rep,
+                    "evidence": evidence,
+                    "gossiped": len(gossiped),
+                    "misbehavior": list(apps[observer].misbehavior),
+                    "byz": registry[0],
+                }
+            finally:
+                EvidencePool.add_evidence = orig_add
+                await net.stop()
+
+        r1 = await one_run(seed=11)
+        r2 = await one_run(seed=11)
+
+        # -- lifecycle, stage by stage (on run 1) -----------------------
+        byz: ByzantineNode = r1["byz"]
+        assert (1, 0, SignedMsgType.PREVOTE) in byz.twins, (
+            "the traitor never double-signed"
+        )
+        assert byz.action_counts.get("withhold_precommit", 0) > 0
+        # detection + commitment: evidence for OUR traitor, within K
+        assert byz_addr in r1["evidence"], "equivocation never reached chain"
+        commit_h, ev = r1["evidence"][byz_addr]
+        ev_bytes = ev.encode()
+        assert isinstance(ev, DuplicateVoteEvidence) and ev.height == 1
+        assert commit_h - 1 <= K_HEIGHTS, (
+            f"evidence took {commit_h - 1} heights (K={K_HEIGHTS})"
+        )
+        # the wire: pending evidence moved on the evidence channel
+        # (add_evidence is called ONLY by the evidence reactor's inbound)
+        assert r1["gossiped"] > 0, "evidence never rode the evidence channel"
+        # the ABCI surface: BeginBlock carried the misbehavior report
+        assert r1["misbehavior"], "app never saw the misbehavior"
+        mb_height, mbs = r1["misbehavior"][0]
+        assert mb_height == commit_h
+        assert mbs[0].type == "duplicate_vote"
+        assert mbs[0].validator_address == byz_addr
+        assert mbs[0].height == 1  # the equivocation height
+        # the auditor: safety + accountability
+        assert r1["audit"].ok, r1["audit"].as_dict()
+        assert not r1["audit"].conflicting_commits
+        assert r1["audit"].evidence_commit_heights == {
+            byz_addr.hex(): commit_h
+        }
+
+        # -- bit-identity across same-seed runs -------------------------
+        assert all(r1["blocks"]), "missing blocks in run 1"
+        assert r1["blocks"] == r2["blocks"], (
+            "block bytes diverged across same-seed byz runs"
+        )
+        assert r1["apps"] == r2["apps"], "app-hash chains diverged"
+        assert ev_bytes == r2["evidence"][byz_addr][1].encode(), (
+            "evidence bytes diverged across same-seed byz runs"
+        )
+        # the byzantine DECISIONS are bit-identical too: the signed twin
+        # set is a pure function of the seed. (Per-send counters like
+        # withhold_precommit are NOT compared — how many times gossip
+        # re-offers a vote is wall-clock cadence, not a decision.)
+        assert byz.twins.keys() == r2["byz"].twins.keys()
+        assert [t.encode() for t in byz.twins.values()] == [
+            t.encode() for t in r2["byz"].twins.values()
+        ]
+        assert (
+            byz.action_counts["equivocate"]
+            == r2["byz"].action_counts["equivocate"]
+        )
+        assert r2["byz"].action_counts.get("withhold_precommit", 0) > 0
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 90.0, f"lifecycle test blew its budget: {elapsed:.1f}s"
+
+
+class TestByzScenarios:
+    @pytest.mark.asyncio
+    async def test_equivocation_under_partition_split_mode(self):
+        """Composition axis: split-mode equivocation (conflicting votes
+        to disjoint, per-peer-stable camps) while node 0 is one-way
+        partitioned — detection must come from honest relay gossip
+        crossing the camp boundary. On a small fast net that crossing
+        races the height advance, so evidence is best-effort here
+        (audit_require_evidence=False) — but SAFETY is absolute, and
+        any evidence that does commit must be prompt."""
+        t0 = time.perf_counter()
+        res = await sc.run_scenario(
+            "byz_equivocation_partition",
+            n_vals=4,
+            target_height=4,
+            seed=3,
+            timeout_s=90.0,
+            stall_s=30.0,
+        )
+        d = res.as_dict()
+        assert res.ok, d
+        assert d["audit"]["ok"], d["audit"]
+        assert not d["audit"]["conflicting_commits"]
+        assert not d["audit"]["app_hash_mismatches"]
+        assert not d["audit"]["late_evidence"]
+        # the traitor really ran split-mode equivocation on the wire
+        assert d["byz_actions"][0]["counts"].get("equivocate", 0) > 0
+        assert {"oneway", "heal"} <= set(res.events_applied)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 75.0, f"blew budget: {elapsed:.1f}s"
+
+    @pytest.mark.asyncio
+    async def test_invalid_sig_gossip_costs_the_peer(self):
+        """Accountability for forgeries: stage-1 ingest disproves the
+        garbage signature, the reactor files a PeerError, and every
+        honest peer manager scores the traitor down."""
+        t0 = time.perf_counter()
+        res = await sc.run_scenario(
+            "byz_invalid_sig",
+            n_vals=4,
+            target_height=4,
+            seed=3,
+            timeout_s=90.0,
+            stall_s=30.0,
+        )
+        d = res.as_dict()
+        assert res.ok, d
+        assert d["audit"]["ok"], d["audit"]
+        penalties = d["audit"]["peer_penalties"]
+        assert penalties, "invalid-sig gossip cost the traitor nothing"
+        assert all(
+            score < 0
+            for by_node in penalties.values()
+            for score in by_node.values()
+        )
+        assert not d["audit"]["unpenalized"]
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 75.0, f"blew budget: {elapsed:.1f}s"
+
+    @pytest.mark.asyncio
+    async def test_flood_and_lies_cannot_stall_honest_nodes(self):
+        """future_round_flood + lying_frames: honest nodes must keep
+        committing (the unwanted-round guard sheds the flood; the
+        VoteSetBits/stall-refresh hardening heals the lying marks). A
+        traitor that lies itself out of catch-up is ITS problem — the
+        liveness gate covers correct nodes only."""
+        t0 = time.perf_counter()
+        res = await sc.run_scenario(
+            "byz_flood_lies",
+            n_vals=4,
+            target_height=4,
+            seed=3,
+            timeout_s=90.0,
+            stall_s=30.0,
+        )
+        d = res.as_dict()
+        assert res.ok, d
+        assert d["audit"]["ok"], d["audit"]
+        honest_heights = [
+            h for i, h in enumerate(res.heights) if i not in res.byz_indices
+        ]
+        assert all(h >= 4 for h in honest_heights), res.heights
+        counts = d["byz_actions"][0]["counts"]
+        assert counts.get("future_round_flood", 0) > 0
+        assert counts.get("lie_round_step", 0) > 0
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 75.0, f"blew budget: {elapsed:.1f}s"
+
+    @pytest.mark.asyncio
+    async def test_f_max_full_taxonomy_4val_smoke(self):
+        """f = ⌊(n−1)/3⌋ = 1 of 4: the full strategy mix under network
+        chaos — the tier-1 half of the acceptance criterion (the
+        50-validator version is slow-marked below)."""
+        t0 = time.perf_counter()
+        res = await sc.run_scenario(
+            "byz_full_taxonomy",
+            n_vals=4,
+            target_height=4,
+            seed=7,
+            timeout_s=120.0,
+            stall_s=40.0,
+        )
+        d = res.as_dict()
+        assert res.ok, d
+        assert d["audit"]["ok"], d["audit"]
+        assert len(res.byz_indices) == 1  # (4-1)//3
+        assert d["audit"]["evidence_commit_heights"], (
+            "equivocators escaped accountability"
+        )
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 100.0, f"blew budget: {elapsed:.1f}s"
+
+    @pytest.mark.asyncio
+    async def test_wedge_dump_carries_byz_action_log(self, tmp_path):
+        """The watchdog contract, extended: a wedged byz run dumps the
+        per-node byzantine action log next to the flight recorder and
+        fault counters."""
+        t0 = time.perf_counter()
+        wedge = sc.Scenario(
+            "byz_wedge_probe",
+            "quorum-killing split with a traitor (watchdog self-test)",
+            byz=((3, ByzConfig(("equivocate",))),),
+            events=(sc.Event(0.4, "partition", groups=((0, 1), (2, 3))),),
+        )
+        res = await sc.run_scenario(
+            wedge,
+            n_vals=4,
+            target_height=6,
+            seed=5,
+            timeout_s=30.0,
+            stall_s=4.0,
+            dump_dir=str(tmp_path),
+        )
+        assert res.wedged and res.dump_path
+        payload = json.loads(open(res.dump_path).read())
+        assert payload["byz"], "wedge dump lost the byz action log"
+        assert payload["byz"][0]["index"] == 3
+        assert "equivocate" in payload["byz"][0]["counts"]
+        assert payload["audit"] is not None
+        # a wedge is a liveness failure — safety must still hold
+        assert not payload["audit"]["conflicting_commits"]
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 60.0, f"blew budget: {elapsed:.1f}s"
+
+
+class TestMaj23ConflictAdmission:
+    """The reference SetPeerMaj23 machinery (vote_set.go votesByBlock),
+    surfaced live by the byz matrix: a laggard whose precommit slot for
+    an equivocator got the TWIN first (chaos reorder) re-rejected the
+    committed majority's real vote as a conflict on every catch-up
+    re-serve — one reordered twin wedged the node a height behind
+    forever. With a peer's +2/3 claim for the committed block,
+    conflicting votes for THAT block are admissible, and crossing +2/3
+    adopts them into the canonical slots so make_commit materializes
+    the real majority."""
+
+    def _setup(self):
+        from tendermint_tpu import testing as tt
+        from tendermint_tpu.types.vote_set import VoteSet
+
+        vals, keys = tt.make_validator_set(4)
+        vs = VoteSet("test-chain", 2, 0, SignedMsgType.PRECOMMIT, vals)
+        bid = tt.make_block_id()
+        ordered = [keys[v.address] for v in vals.validators]
+        return vs, vals, ordered, bid
+
+    def test_conflicting_vote_for_claimed_block_admitted_to_quorum(self):
+        from tendermint_tpu import testing as tt
+        from tendermint_tpu.types.vote_set import ConflictingVoteError
+
+        vs, vals, keys, bid = self._setup()
+        twin_bid = _fabricated_block_id(1, "twin", 2, 0)
+        # equivocator (index 3): the TWIN arrives first and takes the slot
+        twin = tt.make_vote("test-chain", keys[3], 3, 2, 0,
+                            SignedMsgType.PRECOMMIT, twin_bid)
+        assert vs.add_vote(twin)
+        # two honest votes for the real block: 30 of 40 — no +2/3 yet
+        # (the node's own slot precommitted nil, the catch-up shape)
+        for i in (0, 1):
+            assert vs.add_vote(
+                tt.make_vote("test-chain", keys[i], i, 2, 0,
+                             SignedMsgType.PRECOMMIT, bid)
+            )
+        honest = tt.make_vote("test-chain", keys[3], 3, 2, 0,
+                              SignedMsgType.PRECOMMIT, bid)
+        # without a claim: the committed majority's vote is a conflict
+        with pytest.raises(ConflictingVoteError):
+            vs.add_vote(honest)
+        assert vs.two_thirds_majority() is None
+        # with the peer's +2/3 claim: admissible, crosses quorum,
+        # canonical slot adopts the real vote
+        vs.set_peer_maj23_block(bid)
+        assert vs.add_vote(honest)
+        assert vs.two_thirds_majority() == bid
+        assert vs.get_vote(3).block_id == bid, "slot still holds the twin"
+        commit = vs.make_commit()
+        assert commit.block_id == bid
+        assert sum(1 for s in commit.signatures if s.is_commit()) == 3
+        # re-adding the same conflicting vote is a plain duplicate now
+        assert vs.add_vote(honest) is False
+
+    def test_crossing_via_normal_path_still_adopts_bucket_votes(self):
+        """The crossing vote may arrive through the NORMAL add path
+        (the conflict-admitted vote came earlier, before quorum):
+        adoption must fire on the crossing itself, wherever it happens
+        — otherwise make_commit materializes the twin and emits an
+        under-quorum commit."""
+        from tendermint_tpu import testing as tt
+
+        vs, vals, keys, bid = self._setup()
+        twin_bid = _fabricated_block_id(1, "twin", 2, 0)
+        vs.set_peer_maj23_block(bid, "donor")
+        # twin takes slot 3, then the REAL vote arrives before quorum
+        # (admitted into the bucket, tally 10)
+        assert vs.add_vote(
+            tt.make_vote("test-chain", keys[3], 3, 2, 0,
+                         SignedMsgType.PRECOMMIT, twin_bid)
+        )
+        assert vs.add_vote(
+            tt.make_vote("test-chain", keys[3], 3, 2, 0,
+                         SignedMsgType.PRECOMMIT, bid)
+        )
+        assert vs.two_thirds_majority() is None
+        # honest votes cross +2/3 through the NORMAL path
+        for i in (0, 1):
+            assert vs.add_vote(
+                tt.make_vote("test-chain", keys[i], i, 2, 0,
+                             SignedMsgType.PRECOMMIT, bid)
+            )
+        assert vs.two_thirds_majority() == bid
+        assert vs.get_vote(3).block_id == bid, "slot kept the twin"
+        commit = vs.make_commit()
+        assert sum(1 for s in commit.signatures if s.is_commit()) == 3
+
+    def test_claim_table_bounded_per_peer_not_globally(self):
+        """A lying peer burns only its OWN claim budget: spamming
+        fabricated claims must not crowd out an honest donor's claim
+        for the real committed block."""
+        vs, vals, keys, bid = self._setup()
+        for i in range(16):
+            vs.set_peer_maj23_block(
+                _fabricated_block_id(9, "spam", i, 0), "liar"
+            )
+        assert len(vs._maj23_claims_by_peer["liar"]) == 2
+        # the honest donor's claim still lands
+        vs.set_peer_maj23_block(bid, "donor")
+        assert bid.key() in vs._peer_maj23_blocks
+
+    def test_claim_for_unrelated_block_changes_nothing(self):
+        from tendermint_tpu import testing as tt
+        from tendermint_tpu.types.vote_set import ConflictingVoteError
+
+        vs, vals, keys, bid = self._setup()
+        assert vs.add_vote(
+            tt.make_vote("test-chain", keys[3], 3, 2, 0,
+                         SignedMsgType.PRECOMMIT, bid)
+        )
+        other = _fabricated_block_id(2, "other", 2, 0)
+        vs.set_peer_maj23_block(other)
+        # a conflict for a block nobody claimed still raises (evidence)
+        conflicting = tt.make_vote(
+            "test-chain", keys[3], 3, 2, 0, SignedMsgType.PRECOMMIT,
+            _fabricated_block_id(3, "third", 2, 0),
+        )
+        with pytest.raises(ConflictingVoteError):
+            vs.add_vote(conflicting)
+        # nil and None claims are ignored
+        from tendermint_tpu.types.block import NIL_BLOCK_ID
+
+        before = len(vs._peer_maj23_blocks)
+        vs.set_peer_maj23_block(NIL_BLOCK_ID)
+        vs.set_peer_maj23_block(None)
+        assert len(vs._peer_maj23_blocks) == before
+
+
+class TestCatchupPacing:
+    @pytest.mark.asyncio
+    async def test_paced_catchup_still_recovers_laggard(self):
+        """Pacing bounds each catch-up grant at the bucket burst and
+        still recovers a one-way-partitioned laggard after heal — the
+        donors' loop share is bounded, not the laggard's progress."""
+        t0 = time.perf_counter()
+        chaos = ChaosNetwork(ChaosConfig(seed=77))
+        net = RouterNet(
+            4,
+            base_clock=frozen_clock(),
+            chaos=chaos,
+            catchup_rate=60.0,
+            catchup_burst=2,
+        )
+        laggard = net.nodes[3]
+        chaos.partition_oneway(
+            {n.node_id for n in net.nodes[:3]}, {laggard.node_id}
+        )
+        grants: list[int] = []
+        orig = ConsensusReactor._catchup_grant
+
+        def spy(self, peer_id, want, _orig=orig):
+            got = _orig(self, peer_id, want)
+            if want > 0:
+                grants.append(got)
+            return got
+
+        ConsensusReactor._catchup_grant = spy
+        try:
+            await net.start()
+            await asyncio.gather(
+                *(n.cs.wait_for_height(3, 60) for n in net.nodes[:3])
+            )
+            assert laggard.block_store.height() < 3
+            chaos.heal()
+            await laggard.cs.wait_for_height(3, 60)
+        finally:
+            ConsensusReactor._catchup_grant = orig
+            await net.stop()
+        assert grants, "catch-up never consulted the pacing bucket"
+        assert max(grants) <= 2, f"a grant exceeded the burst: {max(grants)}"
+        # pacing spread the service over multiple granted slices
+        assert sum(1 for g in grants if g > 0) >= 2
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 90.0, f"blew budget: {elapsed:.1f}s"
+
+    def test_committee_nets_default_to_paced_catchup(self):
+        paced = RouterNet(20, use_hub=False)
+        assert paced.catchup_rate is not None and paced.catchup_rate > 0
+        small = RouterNet(4, use_hub=False)
+        assert small.catchup_rate is None  # small nets keep old behavior
+
+
+class TestEvidenceReactorFutureBuffer:
+    class _FakePool:
+        def __init__(self, tip):
+            class _S:
+                last_block_height = tip
+
+            self.state = _S()
+            self.added = []
+            self.reject = False
+
+        def add_evidence(self, ev):
+            if self.reject:
+                from tendermint_tpu.evidence.pool import EvidenceError
+
+                raise EvidenceError("bad evidence")
+            self.added.append(ev)
+
+        def pending_evidence(self, max_bytes):
+            return [], 0
+
+    class _FakeChannel:
+        def __init__(self, envs):
+            self._envs = list(envs)
+            self.errors = []
+            self.out_q = asyncio.Queue()
+
+        async def error(self, err):
+            self.errors.append(err)
+
+        def __aiter__(self):
+            return self
+
+        async def __anext__(self):
+            if self._envs:
+                return self._envs.pop(0)
+            await asyncio.Event().wait()  # block forever (reactor stop reaps)
+            raise AssertionError("unreachable")
+
+    class _Ev:
+        def __init__(self, height):
+            self.height = height
+
+        def hash(self):
+            return b"ev" + self.height.to_bytes(8, "big")
+
+    @pytest.mark.asyncio
+    async def test_future_evidence_parks_and_retries_without_peer_error(self):
+        """Evidence for a height we haven't committed yet is honest
+        timing, not a violation: no PeerError (the router would evict a
+        correct peer), parked, and pooled once our tip advances."""
+        from tendermint_tpu.evidence.reactor import EvidenceReactor
+        from tendermint_tpu.p2p.types import Envelope
+
+        pool = self._FakePool(tip=1)
+        ch = self._FakeChannel(
+            [Envelope(0x38, self._Ev(5), from_="peerA")]
+        )
+        r = EvidenceReactor(pool, ch, asyncio.Queue())
+        await r.start()
+        try:
+            await asyncio.sleep(0.1)
+            assert not ch.errors, "future evidence must not cost the peer"
+            assert not pool.added and r._parked
+            pool.state.last_block_height = 5  # tip advanced
+            await asyncio.sleep(0.5)
+            assert [e.height for e in pool.added] == [5]
+            assert not r._parked
+        finally:
+            await r.stop()
+
+    @pytest.mark.asyncio
+    async def test_far_future_junk_cannot_squat_in_the_park(self):
+        """Evidence claiming a height no live peer can have verified is
+        junk: it must not occupy the bounded park forever (it never
+        stops being 'future') and must not block honest near-future
+        parking."""
+        from tendermint_tpu.evidence.reactor import EvidenceReactor, PARK_WINDOW
+        from tendermint_tpu.p2p.types import Envelope
+
+        pool = self._FakePool(tip=1)
+        envs = [Envelope(0x38, self._Ev(10**9), from_="junker")]
+        envs.append(Envelope(0x38, self._Ev(3), from_="peerB"))
+        r = EvidenceReactor(pool, self._FakeChannel(envs), asyncio.Queue())
+        await r.start()
+        try:
+            await asyncio.sleep(0.1)
+            parked = [e.height for e in r._parked.values()]
+            assert parked == [3], parked  # junk dropped, honest parked
+            assert 10**9 > 1 + PARK_WINDOW  # the junk was out-of-window
+        finally:
+            await r.stop()
+
+    def test_conflict_redelivery_survives_transient_processing_failure(self):
+        """A store hiccup while building the evidence must not consume
+        the dedup key — the next gossip re-delivery of the pair has to
+        be able to re-report it (finding: permanent evidence loss)."""
+        from tendermint_tpu import testing as tt
+        from tendermint_tpu.evidence.pool import EvidencePool
+        from tendermint_tpu.store.db import MemDB
+
+        class _Boom:
+            def load_validators(self, h):
+                raise RuntimeError("transient store failure")
+
+            def load(self):
+                return None
+
+        class _State:
+            last_block_height = 5
+
+        pool = EvidencePool.__new__(EvidencePool)
+        pool.db = MemDB()
+        pool.state_store = _Boom()
+        pool.block_store = None
+        import logging as _l
+
+        pool.logger = _l.getLogger("evtest")
+        pool._consensus_buffer = []
+        pool._conflict_keys = set()
+        pool._version = 0
+        pool._pending_cache = None
+        pool.state = _State()
+        vals, keys = tt.make_validator_set(4)
+        ordered = [keys[v.address] for v in vals.validators]
+        a = tt.make_vote("c", ordered[0], 0, 3, 0,
+                         SignedMsgType.PREVOTE, tt.make_block_id(b"a"))
+        b = tt.make_vote("c", ordered[0], 0, 3, 0,
+                         SignedMsgType.PREVOTE, tt.make_block_id(b"b"))
+        pool.report_conflicting_votes(a, b)
+        assert len(pool._consensus_buffer) == 1
+        pool.report_conflicting_votes(a, b)  # dedup holds while buffered
+        assert len(pool._consensus_buffer) == 1
+        pool._process_consensus_buffer(_State())  # store blows up
+        assert not pool._consensus_buffer
+        # the key was released: a re-delivery re-buffers the pair
+        pool.report_conflicting_votes(a, b)
+        assert len(pool._consensus_buffer) == 1
+
+    @pytest.mark.asyncio
+    async def test_genuinely_bad_evidence_still_costs_the_peer(self):
+        from tendermint_tpu.evidence.reactor import EvidenceReactor
+        from tendermint_tpu.p2p.types import Envelope
+
+        pool = self._FakePool(tip=10)
+        pool.reject = True
+        ch = self._FakeChannel(
+            [Envelope(0x38, self._Ev(5), from_="peerA")]
+        )
+        r = EvidenceReactor(pool, ch, asyncio.Queue())
+        await r.start()
+        try:
+            await asyncio.sleep(0.1)
+            assert len(ch.errors) == 1
+            assert ch.errors[0].node_id == "peerA"
+        finally:
+            await r.stop()
+
+
+class TestContainment:
+    def test_production_import_graph_never_reaches_byzantine(self):
+        """node.py and cli.py (the production wiring) must not import
+        consensus/byzantine even transitively — checked on a FRESH
+        interpreter so this session's harness imports can't mask it."""
+        code = (
+            "import sys\n"
+            "import tendermint_tpu.node, tendermint_tpu.cli\n"
+            "bad = [m for m in sys.modules if 'byzantine' in m]\n"
+            "assert not bad, f'production wiring reaches {bad}'\n"
+            "print('CONTAINED')\n"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "CONTAINED" in out.stdout
+
+    def test_harness_is_the_legal_importer(self):
+        # the scenario harness DOES reach it — that is the design
+        import tendermint_tpu.consensus.scenarios as s
+
+        assert s.ByzConfig is ByzConfig
+
+
+@pytest.mark.slow
+class TestByzSweep50:
+    @pytest.mark.asyncio
+    async def test_byz_sweep_50_validators(self):
+        """Byzantine strategies at committee scale: each byz scenario at
+        50 validators over the degree-8 topology, every honest node
+        progressing, the auditor green (evidence committed, no honest
+        disagreement)."""
+        names = [
+            "byz_equivocation",
+            "byz_equivocation_partition",
+            "byz_amnesia_skew",
+            "byz_withhold",
+            "byz_invalid_sig",
+        ]
+        results = await sc.run_sweep(
+            names,
+            n_vals=50,
+            target_height=4,
+            seed=13,
+            timeout_s=420.0,
+            stall_s=120.0,
+            time_scale=4.0,
+            degree=8,
+            audit_k=4,
+        )
+        failures = [
+            r.as_dict()
+            for r in results
+            if not r.ok or not (r.audit or {}).get("ok")
+        ]
+        assert not failures, f"50-validator byz sweep failures: {failures}"
+
+    @pytest.mark.asyncio
+    async def test_f_max_full_soak_50_validators(self):
+        """THE acceptance soak: f = ⌊(50−1)/3⌋ = 16 traitors running
+        equivocation/amnesia/withholding/flood strategies composed with
+        network chaos — zero conflicting honest commits, evidence for
+        every equivocator committed within K heights."""
+        res = await sc.run_scenario(
+            "byz_full_taxonomy",
+            n_vals=50,
+            target_height=4,
+            seed=29,
+            timeout_s=900.0,
+            stall_s=240.0,
+            time_scale=8.0,
+            degree=8,
+            audit_k=6,
+        )
+        d = res.as_dict()
+        assert res.ok, d
+        assert len(res.byz_indices) == 16
+        assert d["audit"]["ok"], d["audit"]
+        assert not d["audit"]["conflicting_commits"]
+        assert not d["audit"]["missing_evidence"]
+        assert len(d["audit"]["evidence_commit_heights"]) >= 1

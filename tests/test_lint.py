@@ -844,6 +844,77 @@ def test_watchdog_wall_clock_allowlisted():
 
 
 # ---------------------------------------------------------------------------
+# byz-containment
+
+
+def test_byzantine_import_flagged_in_production_code():
+    """The exact hazard the rule exists for: production wiring gaining
+    a path to the unguarded double-signing strategy layer."""
+    for src in (
+        "from .consensus import byzantine",
+        "from .consensus.byzantine import ByzConfig",
+        "import tendermint_tpu.consensus.byzantine as byz",
+    ):
+        fs = run(src, "byz-containment", rel="tendermint_tpu/node.py")
+        assert len(fs) == 1, src
+        assert "quarantined" in fs[0].message
+    # relative forms from inside the consensus package
+    for src in (
+        "from .byzantine import ByzantineNode",
+        "from . import byzantine",
+    ):
+        fs = run(
+            src, "byz-containment", rel="tendermint_tpu/consensus/routernet.py"
+        )
+        assert len(fs) == 1, src
+
+
+def test_byzantine_import_allowed_in_harness_and_clean_elsewhere():
+    # the scenario harness and the module itself ARE the legal users
+    assert (
+        run(
+            "from .byzantine import ByzConfig, audit_net",
+            "byz-containment",
+            rel="tendermint_tpu/consensus/scenarios.py",
+        )
+        == []
+    )
+    assert (
+        run(
+            "from . import messages as m",
+            "byz-containment",
+            rel="tendermint_tpu/consensus/byzantine.py",
+        )
+        == []
+    )
+    # unrelated consensus imports never trip it
+    assert (
+        run(
+            "from .consensus import messages, scenarios",
+            "byz-containment",
+            rel="tendermint_tpu/node.py",
+        )
+        == []
+    )
+
+
+def test_byzantine_containment_holds_on_the_real_tree():
+    """The repo itself: the only files naming consensus/byzantine are
+    the allowlisted harness modules (the whole-tree clean gate below
+    covers this too — this pins the specific rule)."""
+    from tendermint_tpu.tools.lint import lint_paths
+
+    all_findings, n_files = lint_paths(
+        [os.path.join(REPO, "tendermint_tpu")],
+        [RULES_BY_ID["byz-containment"]],
+        Allowlist.load(DEFAULT_ALLOWLIST),
+    )
+    findings = [f for f in all_findings if f.rule == "byz-containment"]
+    assert n_files > 100  # the whole tree was actually scanned
+    assert findings == [], [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
 # pragmas
 
 
