@@ -1273,6 +1273,188 @@ def bench_commit_ab(n_vals: int = 150, n_commits: int = 2) -> dict:
     return out
 
 
+def bench_light_fleet(
+    n_vals: int = 150,
+    n_clients: int = 64,
+    n_heights: int = 6,
+    timeout_s: float = 420.0,
+) -> dict:
+    """light_fleet config: N open-loop light clients syncing genesis→tip
+    against ONE LightD (light/fleet.py) — the first genuinely read-heavy
+    "millions of users" workload. Measured per hop-proof scheme
+    (aggregate-hop vs per-sig, the arXiv:2302.00418 A/B):
+
+      syncs/s, p50/p99 sync latency, hop-cache hit rate, shed rate
+      (bounded sessions + explicit busy-shed), verify sigs/s
+      (signatures COVERED per second — one aggregate pairing covers the
+      whole committee), hop-proof wire bytes, and the hop-cache
+      amortization factor: (cold per-client verification hops × N) /
+      hops LightD actually verified.
+
+    BOUNDED (the multichip/chaos_soak discipline): every phase runs
+    under an outer asyncio timeout and returns a structured outcome on
+    wedge/error — never a hang. CPU-image scale-down via
+    TMTPU_BENCH_LF_VALS / _CLIENTS / _HEIGHTS (pure-python BLS signing
+    dominates chain construction there; the wire and amortization
+    numbers are backend-independent)."""
+    import asyncio
+
+    from tendermint_tpu import testing
+    from tendermint_tpu.config import LightDConfig
+    from tendermint_tpu.light import fleet as lf
+    from tendermint_tpu.light.client import LightClient, TrustOptions
+
+    chain_id = "lf-chain"
+    out: dict = {
+        "n_vals": n_vals,
+        "n_clients": n_clients,
+        "n_heights": n_heights,
+        "schemes": {},
+    }
+
+    async def _one_scheme(scheme: str, chain, aggregate_hops: bool) -> dict:
+        import tempfile
+
+        from tendermint_tpu.libs.watchdog import LoopWatchdog
+
+        # watchdog + outer timeout (the chaos_soak bounding discipline):
+        # the wait_for below hard-bounds the phase; the loop watchdog
+        # dumps a stack + flight-recorder report if the serving loop
+        # wedges mid-phase, so a hang is diagnosable from disk
+        wd = LoopWatchdog(
+            tempfile.mkdtemp(prefix="light-fleet-wd-"), threshold_s=30.0
+        )
+        wd.start()
+        trust = TrustOptions(
+            period_ns=10**18, height=1, hash=chain[0].header.hash()
+        )
+        now = chain[-1].header.time_ns + 10**9
+        # cold baseline: ONE client verifying alone — the per-client
+        # work the fleet would multiply by N without a serving layer
+        cold_prov = testing.make_list_provider(chain, chain_id)
+        lc = LightClient(chain_id, trust, cold_prov)
+        t0 = time.perf_counter()
+        await lc.verify_light_block_at_height(n_heights, now)
+        cold_s = time.perf_counter() - t0
+        cold_hops = cold_prov.fetches  # anchor + every hop fetched
+
+        prov = testing.make_list_provider(chain, chain_id)
+        d = lf.LightD(
+            chain_id,
+            trust,
+            prov,
+            config=LightDConfig(
+                max_sessions=32, aggregate_hops=aggregate_hops
+            ),
+        )
+        await d.start()
+        latencies: list[float] = []
+        shed = 0
+
+        async def one_client():
+            nonlocal shed
+            c0 = time.perf_counter()
+            try:
+                await d.sync(n_heights, now_ns=now)
+            except lf.LightDBusyError:
+                shed += 1
+                return
+            latencies.append(time.perf_counter() - c0)
+
+        try:
+            t0 = time.perf_counter()
+            await asyncio.gather(*(one_client() for _ in range(n_clients)))
+            elapsed = max(time.perf_counter() - t0, 1e-9)
+            proof = await d.hop_proof(n_heights)
+            stats = dict(d.stats)
+        finally:
+            await d.stop()
+            wd.stop()
+        latencies.sort()
+
+        def pct(p: float) -> float:
+            if not latencies:
+                return 0.0
+            return latencies[min(len(latencies) - 1, int(p * len(latencies)))]
+
+        hops = max(stats["hops_verified"], 1.0)
+        lookups = stats["hop_cache_hits"] + stats["hop_cache_misses"]
+        return {
+            "proof_scheme": proof.scheme,
+            "hop_proof_wire_bytes": proof.wire_bytes(),
+            "sig_bytes_per_hop": (
+                96 if proof.scheme == lf.SCHEME_AGGREGATE else 64 * n_vals
+            ),
+            "syncs_per_s": round(len(latencies) / elapsed, 1),
+            "completed": len(latencies),
+            "shed": shed,
+            "shed_rate": round(shed / n_clients, 4),
+            "p50_sync_s": round(pct(0.50), 5),
+            "p99_sync_s": round(pct(0.99), 5),
+            "hop_cache_hit_rate": round(
+                stats["hop_cache_hits"] / lookups if lookups else 0.0, 4
+            ),
+            "coalesced": stats["coalesced"],
+            "hops_verified": stats["hops_verified"],
+            "sigs_covered_per_s": round(hops * n_vals / elapsed, 1),
+            "cold_client_s": round(cold_s, 4),
+            "cold_client_hops": cold_hops,
+            # the headline: verification work a cold fleet would have
+            # done / work the serving layer actually did
+            "amortization_factor": round(
+                (cold_hops * n_clients) / max(prov.fetches, 1), 2
+            ),
+        }
+
+    for scheme, key_types, agg in (
+        ("per_sig", ("ed25519",), False),
+        ("bls_aggregate", ("bls12381",), True),
+    ):
+        t0 = time.perf_counter()
+        try:
+            log(f"light_fleet: building {n_vals}-val {scheme} chain …")
+            vals, by_addr = testing.make_validator_set(
+                n_vals, key_types=key_types, seed=b"lf-" + scheme.encode()
+            )
+            chain = testing.make_light_chain(
+                n_heights, vals, by_addr, chain_id
+            )
+            build_s = time.perf_counter() - t0
+
+            async def bounded(_chain=chain, _scheme=scheme, _agg=agg):
+                return await asyncio.wait_for(
+                    _one_scheme(_scheme, _chain, _agg), timeout_s
+                )
+
+            rec = asyncio.run(bounded())
+            rec["outcome"] = "ok"
+            rec["chain_build_s"] = round(build_s, 2)
+        except Exception as e:  # noqa: BLE001 — structured outcome
+            rec = {"outcome": f"error: {e!r}"[:200]}
+        rec["wall_s"] = round(time.perf_counter() - t0, 2)
+        out["schemes"][scheme] = rec
+        log(
+            f"light_fleet[{scheme}]: {rec.get('outcome')} "
+            f"{rec.get('syncs_per_s', 0)} syncs/s "
+            f"{rec.get('sigs_covered_per_s', 0)} sigs/s "
+            f"hit={rec.get('hop_cache_hit_rate', 0)} "
+            f"shed={rec.get('shed_rate', 0)} "
+            f"amortization={rec.get('amortization_factor', 0)}x "
+            f"wire={rec.get('hop_proof_wire_bytes', 0)}B"
+        )
+    per, agg = out["schemes"].get("per_sig", {}), out["schemes"].get(
+        "bls_aggregate", {}
+    )
+    if per.get("outcome") == "ok" and agg.get("outcome") == "ok":
+        out["wire_ratio"] = round(
+            per["hop_proof_wire_bytes"] / agg["hop_proof_wire_bytes"], 2
+        )
+        out["sig_bytes_ratio"] = round(
+            per["sig_bytes_per_hop"] / agg["sig_bytes_per_hop"], 1
+        )
+    return out
+
+
 def _multichip_measure(n_sigs: int, reps: int = 2) -> dict:
     """multichip config, in-process half: sharded vs single-device
     verification of the same batch on whatever mesh this process sees.
@@ -1878,6 +2060,38 @@ def main() -> None:
         )
     except Exception as e:  # noqa: BLE001
         log(f"commit-ab bench failed: {e!r}")
+    # light_fleet runs on BOTH backends, BOUNDED: N open-loop light
+    # clients syncing genesis→tip against one LightD — syncs/s, sigs/s,
+    # hop-cache hit rate, shed rate, p50/p99 sync latency, and the
+    # aggregate-hop vs per-sig A/B (wire bytes × sigs/s × syncs/s). On
+    # CPU images the committee scales down (pure-python BLS signing
+    # dominates chain construction); wire + amortization numbers are
+    # backend-independent.
+    if os.environ.get("TMTPU_BENCH_LIGHT_FLEET") != "0":
+        try:
+            lf_vals = int(
+                os.environ.get(
+                    "TMTPU_BENCH_LF_VALS",
+                    "150" if backend != "cpu" else "25",
+                )
+            )
+            lf_clients = int(
+                os.environ.get(
+                    "TMTPU_BENCH_LF_CLIENTS",
+                    "64" if backend != "cpu" else "24",
+                )
+            )
+            lf_heights = int(
+                os.environ.get(
+                    "TMTPU_BENCH_LF_HEIGHTS",
+                    "6" if backend != "cpu" else "4",
+                )
+            )
+            extra["light_fleet"] = bench_light_fleet(
+                lf_vals, lf_clients, lf_heights
+            )
+        except Exception as e:  # noqa: BLE001
+            log(f"light-fleet bench failed: {e!r}")
     # verifyd runs on BOTH backends, BOUNDED: N worker processes flood
     # one sidecar daemon vs N in-process backends — aggregate sigs/s,
     # attach counts (the one-warm-mesh amortization headline), p99

@@ -219,6 +219,30 @@ class VerifyHubConfig:
 
 
 @dataclass
+class LightDConfig:
+    """LightD — the light-client serving layer (light/fleet.py): one
+    verified-hop cache + aggregate hop proofs served to a client fleet.
+    Env mirrors win over TOML (the VerifyHub contract):
+    TMTPU_LIGHTD_SESSIONS / TMTPU_LIGHTD_PROOF_CACHE /
+    TMTPU_LIGHTD_AGG_HOPS=0."""
+
+    #: concurrent verification sessions before arrivals are rejected
+    #: with busy (LightDBusyError — the ingress backpressure contract;
+    #: cache hits and coalesced same-height joins never shed)
+    max_sessions: int = 64
+    #: hop proofs kept per LightD, encodings memoized (insertion-evicted)
+    proof_cache: int = 1024
+    #: fold BLS committees' hop commits into the 96-byte aggregate wire
+    #: variant (verified through verify_hub.verify_aggregate — one
+    #: pairing per hop); per-sig fallback applies either way for
+    #: non-BLS committees
+    aggregate_hops: bool = True
+    #: sequential (adjacent-chain) verification instead of skipping —
+    #: the audit/archival shape; skipping is the serving default
+    sequential: bool = False
+
+
+@dataclass
 class TraceConfig:
     """Flight-recorder tracing (libs/trace.py): structured spans over
     the verify funnel landing in a bounded per-process ring buffer,
@@ -266,6 +290,7 @@ class Config:
     chaos: ChaosNetConfig = field(default_factory=ChaosNetConfig)
     chaos_fs: ChaosFSConfig = field(default_factory=ChaosFSConfig)
     verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
+    lightd: LightDConfig = field(default_factory=LightDConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
 
 
@@ -310,6 +335,8 @@ def config_to_toml(cfg: Config) -> str:
         "",
         _section_to_toml("verify_hub", cfg.verify_hub),
         "",
+        _section_to_toml("lightd", cfg.lightd),
+        "",
         _section_to_toml("trace", cfg.trace),
         "",
     ]
@@ -336,6 +363,7 @@ def config_from_toml(text: str) -> Config:
         ("chaos", cfg.chaos),
         ("chaos_fs", cfg.chaos_fs),
         ("verify_hub", cfg.verify_hub),
+        ("lightd", cfg.lightd),
         ("trace", cfg.trace),
     ):
         _apply_section(obj, data.get(section, {}))

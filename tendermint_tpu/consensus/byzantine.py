@@ -70,7 +70,7 @@ from ..crypto.hashes import sha256
 from ..p2p.types import Envelope
 from ..privval import PrivValidator
 from ..types.block import BlockID, PartSetHeader
-from ..types.evidence import DuplicateVoteEvidence
+from ..types.evidence import DuplicateVoteEvidence, LightClientAttackEvidence
 from ..types.keys import SignedMsgType
 from ..types.vote import Proposal, Vote
 from . import messages as m
@@ -624,6 +624,14 @@ class AuditReport:
     evidence_lag_heights: dict[str, int] = field(default_factory=dict)
     missing_evidence: list[int] = field(default_factory=list)
     late_evidence: list[dict] = field(default_factory=list)
+    #: light-client-attack accountability (the LightFleet axis):
+    #: attributed signer address hex -> height its LCA evidence
+    #: committed at, and commit height − conflicting height (the
+    #: time-to-evidence-commit figure for light attacks)
+    lca_commit_heights: dict[str, int] = field(default_factory=dict)
+    lca_lag_heights: dict[str, int] = field(default_factory=dict)
+    #: expected lunatic signers whose attack never reached the chain
+    missing_lca: list[str] = field(default_factory=list)
     #: byz index -> {honest index: peer score} where penalized
     peer_penalties: dict[int, dict] = field(default_factory=dict)
     unpenalized: list[int] = field(default_factory=list)
@@ -641,6 +649,9 @@ class AuditReport:
             "evidence_lag_heights": dict(self.evidence_lag_heights),
             "missing_evidence": self.missing_evidence,
             "late_evidence": self.late_evidence,
+            "lca_commit_heights": dict(self.lca_commit_heights),
+            "lca_lag_heights": dict(self.lca_lag_heights),
+            "missing_lca": self.missing_lca,
             "peer_penalties": {
                 str(k): v for k, v in self.peer_penalties.items()
             },
@@ -666,12 +677,32 @@ def committed_duplicate_vote_evidence(node) -> dict[bytes, tuple[int, object]]:
     return out
 
 
+def committed_light_client_attack_evidence(
+    node,
+) -> dict[bytes, tuple[int, object]]:
+    """Scan one node's committed chain for LightClientAttackEvidence:
+    attributed (byzantine) signer address -> (first height its evidence
+    committed at, the evidence)."""
+    out: dict[bytes, tuple[int, object]] = {}
+    store = node.block_store
+    for h in range(1, store.height() + 1):
+        blk = store.load_block(h)
+        if blk is None:
+            continue
+        for ev in blk.evidence:
+            if isinstance(ev, LightClientAttackEvidence):
+                for val in ev.byzantine_validators:
+                    out.setdefault(val.address, (h, ev))
+    return out
+
+
 def audit_net(
     net,
     byz_nodes: list[ByzantineNode] | None = None,
     *,
     k_heights: int = 3,
     require_evidence: bool = True,
+    expect_lca: tuple[bytes, ...] = (),
 ) -> AuditReport:
     """The safety + accountability audit (module docstring): agreement
     over every committed height, evidence accountability for every
@@ -746,6 +777,31 @@ def audit_net(
                 }
             )
 
+    # 3b: light-client-attack accountability — every expected lunatic
+    # signer (addresses from the scenario's LunaticProvider plan) must
+    # appear in a committed LightClientAttackEvidence's attribution
+    # within K heights of the conflicting (forged) height
+    if expect_lca:
+        lca = committed_light_client_attack_evidence(best)
+        for addr in expect_lca:
+            hit = lca.get(addr)
+            if hit is None:
+                rep.missing_lca.append(addr.hex())
+                continue
+            commit_h, ev = hit
+            rep.lca_commit_heights[addr.hex()] = commit_h
+            lag = commit_h - ev.conflicting_height
+            rep.lca_lag_heights[addr.hex()] = lag
+            if lag > k_heights:
+                rep.late_evidence.append(
+                    {
+                        "lca_signer": addr.hex(),
+                        "forged_at": ev.conflicting_height,
+                        "committed_at": commit_h,
+                        "k": k_heights,
+                    }
+                )
+
     # 4: invalid-signature gossip must have COST the traitor on at
     # least one honest node (score drop or ban — the PeerError path)
     for b in byz_nodes:
@@ -766,6 +822,7 @@ def audit_net(
         or rep.app_hash_mismatches
         or rep.missing_evidence
         or rep.late_evidence
+        or rep.missing_lca
         or rep.unpenalized
     )
     return rep

@@ -31,7 +31,12 @@ from dataclasses import dataclass, field, replace
 
 from ..libs.chaos import ChaosConfig, ChaosNetwork
 from ..libs.chaosfs import ChaosFS, ChaosFSConfig
-from .byzantine import ByzConfig, audit_net, byz_prepare_hook
+from .byzantine import (
+    ByzConfig,
+    audit_net,
+    byz_prepare_hook,
+    committed_light_client_attack_evidence,
+)
 from .harness import GENESIS_TIME_NS, MS, fast_config
 from .routernet import RouterNet, committee_config
 
@@ -661,6 +666,190 @@ async def run_scenario(
         byz_indices=sorted(byz_plan),
         byz_actions=byz_actions,
     )
+
+
+async def run_light_attack(
+    *,
+    n_vals: int = 3,
+    seed: int = 1,
+    trust_height: int = 1,
+    attack_offset: int = 2,
+    k_heights: int = 3,
+    timeout_s: float = 90.0,
+    commit_window_s: float = 2.5,
+    chaos_cfg: ChaosConfig | None = None,
+    app_factory=None,
+    use_hub: bool = True,
+    degree: int = 8,
+    config=None,
+) -> dict:
+    """The live lunatic light-client attack over RouterNet — the
+    LightFleet Byzantine axis (light/byzantine.py), end to end:
+
+      honest committee commits over real routers (chaos-wrapped when
+      `chaos_cfg` is set) → a LightD (light/fleet.py) syncs through a
+      traitor primary (`LunaticProvider`: a forged header signed out of
+      band by a seeded >1/3-power subset reusing their REAL keys) with
+      honest witnesses → the witness cross-check detects the divergence
+      → `LightClientAttackEvidence` forms and lands in every honest
+      pool → evidence-channel gossip → on-chain commitment →
+      BeginBlock misbehavior — audited by `audit_net` (agreement + LCA
+      accountability within `k_heights` of the forged height).
+
+    Determinism construction (the bit-identity contract at n_vals=3):
+    frozen clock + 3 equal-power validators pin every commit signer
+    set and timestamp; the colluders behave HONESTLY in consensus (the
+    forgery is an offline key reuse), so the chain itself is the
+    deterministic baseline; a `commit_window_s` timeout_commit opens a
+    pause after the attack height inside which detection + direct
+    evidence reporting to every witness pool completes, pinning the
+    evidence's commit height. Two same-seed runs then produce
+    bit-identical block AND evidence bytes.
+
+    Attack heights sit `attack_offset >= 2` above the trust anchor:
+    adjacent hops pin the exact next validator set by hash and reject
+    the forgery before the witness cross-check — a negative test, not
+    an attack.
+
+    Returns a structured outcome dict (never raises on wedge/timeout —
+    the chaos_soak contract)."""
+    from ..libs.clock import ManualClock
+    from ..light.byzantine import LunaticConfig, LunaticProvider
+    from ..light.client import Divergence, TrustOptions
+    from ..light.fleet import LightD
+    from ..light.provider import BlockStoreProvider
+    from ..state.state import state_from_genesis
+
+    attack_height = trust_height + attack_offset
+    if attack_offset < 2:
+        raise ValueError("lunatic attack heights must be non-adjacent")
+    if config is None:
+        base = fast_config() if n_vals <= 16 else committee_config(n_vals)
+        config = replace(
+            base,
+            timeout_commit_ns=int(commit_window_s * 1e9),
+            skip_timeout_commit=False,
+        )
+    chaos = (
+        ChaosNetwork(replace(chaos_cfg, seed=seed))
+        if chaos_cfg is not None and chaos_cfg.enabled()
+        else None
+    )
+    net = RouterNet(
+        n_vals,
+        config=config,
+        chaos=chaos,
+        base_clock=ManualClock(GENESIS_TIME_NS - 500 * MS),
+        degree=degree,
+        topo_seed=seed,
+        use_hub=use_hub,
+        app_factory=app_factory,
+    )
+    chain_id = net.genesis.chain_id
+    out: dict = {
+        "outcome": "error",
+        "n_vals": n_vals,
+        "seed": seed,
+        "attack_height": attack_height,
+        "divergence_detected": False,
+        "served_forged": 0,
+        "traitors": [],
+        "lca_committed_at": None,
+        "time_to_lca_commit_heights": None,
+        "audit": None,
+        "blocks_hex": [],
+        "lca_evidence_hex": "",
+        "heights": [],
+        "elapsed_s": 0.0,
+        "error": "",
+    }
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    lightd = None
+    try:
+        await asyncio.wait_for(net.start(), timeout_s)
+        await asyncio.wait_for(
+            net.wait_for_height(attack_height, timeout_s), timeout_s
+        )
+        genesis_vals = state_from_genesis(net.genesis).validators
+        keys_by_addr = {k.pub_key().address(): k for k in net.keys}
+        providers = [
+            BlockStoreProvider(
+                chain_id,
+                n.block_store,
+                n.inner.state_store,
+                evidence_pool=n.inner.evidence_pool,
+            )
+            for n in net.nodes
+        ]
+        lunatic = LunaticProvider(
+            providers[0],
+            LunaticConfig(
+                (attack_height,), seed=seed, n_traitors=n_vals // 3 + 1
+            ),
+            genesis_vals,
+            keys_by_addr,
+        )
+        out["traitors"] = [a.hex() for a in lunatic.traitor_addresses()]
+        anchor_meta = net.nodes[0].block_store.load_block_meta(trust_height)
+        trust = TrustOptions(
+            period_ns=10 * 365 * 24 * 3600 * 10**9,
+            height=trust_height,
+            hash=anchor_meta.header.hash(),
+        )
+        lightd = LightD(chain_id, trust, lunatic, witnesses=providers)
+        await lightd.start()
+        tip_time = net.nodes[0].block_store.load_block_meta(
+            attack_height
+        ).header.time_ns
+        try:
+            await asyncio.wait_for(
+                lightd.sync(attack_height, now_ns=tip_time + 10**9), 60.0
+            )
+        except Divergence:
+            out["divergence_detected"] = True
+        out["served_forged"] = len(lunatic.served_forged)
+        out["lightd_stats"] = dict(lightd.stats)
+        # wait (bounded by K heights) for the evidence to reach a block
+        expect = lunatic.traitor_addresses()
+        target = attack_height + 1
+        for _ in range(k_heights + 1):
+            await asyncio.wait_for(
+                net.wait_for_height(target, timeout_s), timeout_s
+            )
+            lca = committed_light_client_attack_evidence(net.nodes[0])
+            if all(a in lca for a in expect):
+                commit_h, ev = lca[expect[0]]
+                out["lca_committed_at"] = commit_h
+                out["time_to_lca_commit_heights"] = (
+                    commit_h - ev.conflicting_height
+                )
+                out["lca_evidence_hex"] = ev.encode().hex()
+                break
+            target += 1
+        audit = audit_net(
+            net, [], k_heights=k_heights, expect_lca=expect
+        )
+        out["audit"] = audit.as_dict()
+        out["blocks_hex"] = [
+            b.hex() for b in net.block_fingerprints(target, node=0)
+        ]
+        out["outcome"] = (
+            "ok"
+            if out["divergence_detected"]
+            and out["lca_committed_at"] is not None
+            and audit.ok
+            else "failed"
+        )
+    except Exception as e:  # noqa: BLE001 — structured outcome contract
+        out["error"] = repr(e)
+    finally:
+        if lightd is not None:
+            await lightd.stop()
+        out["heights"] = net.heights()
+        out["elapsed_s"] = round(loop.time() - t0, 3)
+        await net.stop()
+    return out
 
 
 async def run_sweep(

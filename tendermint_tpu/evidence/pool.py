@@ -12,7 +12,9 @@ reference pool.go:61 isExpired)."""
 from __future__ import annotations
 
 import logging
+from collections import OrderedDict
 
+from ..crypto.hashes import sha256
 from ..store.db import DB
 from ..types.evidence import (
     DuplicateVoteEvidence,
@@ -65,6 +67,16 @@ class EvidencePool(EvidencePoolI):
         # without dedup a committee-scale equivocation flood grows the
         # buffer (and the per-commit processing pass) without bound
         self._conflict_keys: set[tuple] = set()
+        # verified-LCA memo (bounded, hash-keyed): light-client-attack
+        # verification reruns TWO commit checks over a committee-scale
+        # conflicting block (trusting + own-set — pairing-heavy for BLS
+        # committees), and every proposal carrying the evidence re-asks
+        # through check_evidence until it's pending here. The inputs
+        # behind a hash are immutable (committed historical state), so
+        # a PASSED verdict is safe to replay; failures are never
+        # memoized — a "conflicting height not committed yet" rejection
+        # legitimately becomes a pass as the tip advances.
+        self._lca_verified: "OrderedDict[bytes, bool]" = OrderedDict()
 
     # -- intake ----------------------------------------------------------
 
@@ -115,7 +127,19 @@ class EvidencePool(EvidencePoolI):
         if isinstance(ev, DuplicateVoteEvidence):
             self._verify_duplicate_vote(ev, meta.header.time_ns)
         elif isinstance(ev, LightClientAttackEvidence):
+            # memo key covers the FULL encoding, not ev.hash():
+            # the dedup hash deliberately collapses variants that differ
+            # in attribution/timestamp/power, and a same-hash variant
+            # with a forged byzantine_validators list must re-run the
+            # attribution check, never ride a previous verdict
+            memo_key = sha256(ev.encode())
+            if self._lca_verified.get(memo_key):
+                self._lca_verified.move_to_end(memo_key)
+                return
             self._verify_light_client_attack(ev, meta.header.time_ns)
+            self._lca_verified[memo_key] = True
+            while len(self._lca_verified) > 512:
+                self._lca_verified.popitem(last=False)
         else:
             raise EvidenceError(f"unsupported evidence type {type(ev).__name__}")
 
@@ -175,10 +199,14 @@ class EvidencePool(EvidencePoolI):
         conflicting = ev.conflicting_block
         sh = conflicting.signed_header
         try:
+            # backfill lane: evidence verification is accountability
+            # traffic, never the consensus hot path — a flood of LCA
+            # reports fills device batches behind live votes
             if ev.common_height != conflicting.height:
                 # skipping attack: 1/3 of the common set must have signed
                 verify_commit_light_trusting(
-                    chain_id, common_vals, sh.commit, Fraction(1, 3)
+                    chain_id, common_vals, sh.commit, Fraction(1, 3),
+                    lane="backfill",
                 )
             else:
                 if conflicting.header.validators_hash != common_vals.hash():
@@ -192,6 +220,7 @@ class EvidencePool(EvidencePoolI):
                 sh.commit.block_id,
                 conflicting.height,
                 sh.commit,
+                lane="backfill",
             )
         except InvalidCommitError as e:
             raise EvidenceError(f"conflicting block not properly signed: {e}") from e

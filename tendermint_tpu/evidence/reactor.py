@@ -83,9 +83,23 @@ class EvidenceReactor(Service):
                     t.cancel()
                 self._sent.pop(upd.node_id, None)
 
+    @staticmethod
+    def _verify_height(ev) -> int:
+        """The height our tip must reach before the pool can verify this
+        evidence. For DuplicateVoteEvidence that is the vote height; a
+        LightClientAttackEvidence additionally needs the CONFLICTING
+        height committed (the pool compares the forged header against
+        our own block there) — its `height` property is the common
+        height, which can trail the conflicting height by the whole
+        skipping hop."""
+        return max(ev.height, getattr(ev, "conflicting_height", ev.height))
+
     def _is_future(self, ev) -> bool:
         state = self.pool.state
-        return state is not None and ev.height > state.last_block_height
+        return (
+            state is not None
+            and self._verify_height(ev) > state.last_block_height
+        )
 
     async def _process_inbound(self) -> None:
         async for env in self.channel:
@@ -94,7 +108,7 @@ class EvidenceReactor(Service):
                 if self._is_future(ev):
                     tip = self.pool.state.last_block_height
                     if (
-                        ev.height <= tip + PARK_WINDOW
+                        self._verify_height(ev) <= tip + PARK_WINDOW
                         and len(self._parked) < MAX_PARKED
                     ):
                         self._parked[ev.hash()] = ev

@@ -300,6 +300,52 @@ class NodeMetrics:
             "stage-A parse + signature pre-verify latency per tx",
             buckets=ADMIT_BUCKETS,
         )
+        # LightD — the light-client serving layer (light/fleet.py; live
+        # instances registered process-wide, folded at render time like
+        # the ingress family)
+        from ..light.fleet import SYNC_BUCKETS
+
+        self.lightd_syncs = r.counter(
+            "lightd", "syncs", "sync requests received (incl. shed)"
+        )
+        self.lightd_sheds = r.counter(
+            "lightd", "sheds",
+            "syncs rejected-with-busy at the session bound (backpressure)",
+        )
+        self.lightd_coalesced = r.counter(
+            "lightd", "coalesced", "syncs joined onto an in-flight session"
+        )
+        self.lightd_hop_cache_hits = r.counter(
+            "lightd", "hop_cache_hits",
+            "syncs answered from the verified-hop cache (zero verification)",
+        )
+        self.lightd_hops_verified = r.counter(
+            "lightd", "hops_verified",
+            "skipping-verification checkpoints verified once and cached",
+        )
+        self.lightd_hop_scheme = r.counter(
+            "lightd", "hops_by_scheme",
+            "hops served per wire scheme (bls-aggregate vs per-sig)",
+        )
+        self.lightd_proofs_served = r.counter(
+            "lightd", "proofs_served", "aggregate hop proofs served"
+        )
+        self.lightd_divergences = r.counter(
+            "lightd", "divergences",
+            "witness cross-checks that detected a light-client attack",
+        )
+        self.lightd_sessions = r.gauge(
+            "lightd", "sessions", "verification sessions in flight right now"
+        )
+        self.lightd_hop_cache_hit_rate = r.gauge(
+            "lightd", "hop_cache_hit_rate", "hits / (hits + misses)"
+        )
+        self.lightd_sync_latency = r.histogram(
+            "lightd",
+            "sync_latency_seconds",
+            "request-to-verified-verdict latency per sync",
+            buckets=SYNC_BUCKETS,
+        )
         # event fan-out (libs/pubsub.py drop_on_full subscriptions —
         # the websocket path; folded from pubsub.DROPPED at render)
         self.pubsub_dropped_events = r.counter(
@@ -694,6 +740,37 @@ class NodeMetrics:
                 dst._sum = sum_
                 dst._count = count
 
+    def _fold_lightd(self) -> None:
+        from ..light import fleet
+
+        s, hist = fleet.aggregate()
+        if s is None:
+            return
+        self.lightd_syncs._values[()] = s["syncs"]
+        self.lightd_sheds._values[()] = s["sheds"]
+        self.lightd_coalesced._values[()] = s["coalesced"]
+        self.lightd_hop_cache_hits._values[()] = s["hop_cache_hits"]
+        self.lightd_hops_verified._values[()] = s["hops_verified"]
+        self.lightd_hop_scheme._values[(("scheme", "bls-aggregate"),)] = s[
+            "agg_hops"
+        ]
+        self.lightd_hop_scheme._values[(("scheme", "per-sig"),)] = s[
+            "per_sig_hops"
+        ]
+        self.lightd_proofs_served._values[()] = s["proofs_served"]
+        self.lightd_divergences._values[()] = s["divergences"]
+        self.lightd_sessions.set(s["sessions_now"])
+        lookups = s["hop_cache_hits"] + s["hop_cache_misses"]
+        self.lightd_hop_cache_hit_rate.set(
+            round(s["hop_cache_hits"] / lookups, 4) if lookups else 0.0
+        )
+        counts, sum_, count = hist
+        dst = self.lightd_sync_latency
+        if len(counts) == len(dst._counts):  # same SYNC_BUCKETS layout
+            dst._counts = counts
+            dst._sum = sum_
+            dst._count = count
+
     def _fold_steps(self) -> None:
         from ..consensus.state import aggregate_step_metrics
 
@@ -760,6 +837,7 @@ class NodeMetrics:
         self._fold_verifyd()
         self._fold_ingest()
         self._fold_mempool()
+        self._fold_lightd()
         self._fold_steps()
         self._fold_backend()
         self._fold_bls()

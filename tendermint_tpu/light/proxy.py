@@ -9,15 +9,30 @@ full node.
 The env object plugs straight into rpc/server.RPCServer — it implements
 the same route-method protocol as rpc/core.Environment, raising RPCError
 for the routes a stateless proxy cannot serve (tx indexing, consensus
-introspection)."""
+introspection).
+
+LightFleet integration: construct with ``lightd=`` (a running
+light/fleet.LightD) and every header-shaped read rides the shared
+verified-hop cache instead of this proxy's embedded client — N proxies
+(or N requests) share one verification per hop — and two fleet routes
+appear: ``light_block`` (the verified block) and ``hop_proof`` (the
+aggregate hop proof: hex wire bytes + scheme). LightD's explicit
+busy-shed maps to the RPC busy contract: ``LIGHT_BUSY_CODE`` (the
+MEMPOOL_BUSY_CODE pattern — back off and resubmit, nothing was
+queued)."""
 
 from __future__ import annotations
 
 import logging
 
 from ..crypto import merkle
-from ..rpc.core import RPCError
+from ..rpc.core import MEMPOOL_BUSY_CODE, RPCError
 from .client import LightClient
+
+#: RPCError code for a shed light read — same value as the mempool's
+#: busy CheckTx code on purpose: one "busy, back off" number for
+#: clients across the whole read+write surface
+LIGHT_BUSY_CODE = MEMPOOL_BUSY_CODE
 
 _UNSUPPORTED = (
     "net_info",
@@ -43,10 +58,12 @@ class LightProxyEnv:
         light_client: LightClient,
         primary_rpc,  # rpc.client.HTTPClient against the primary
         *,
+        lightd=None,  # light.fleet.LightD: reads ride the shared hop cache
         logger: logging.Logger | None = None,
     ):
         self.lc = light_client
         self.primary = primary_rpc
+        self.lightd = lightd
         self.logger = logger or logging.getLogger("light.proxy")
         self.metrics = None
 
@@ -62,8 +79,55 @@ class LightProxyEnv:
 
         return handler
 
+    async def _verified(self, height: int):
+        """One verified light block: through the attached LightD (shared
+        hop cache + coalescing; busy-shed surfaces as the RPC busy
+        contract) or this proxy's own embedded client."""
+        if self.lightd is None:
+            return await self.lc.verify_light_block_at_height(height)
+        from .fleet import LightDBusyError
+
+        try:
+            return await self.lightd.sync(height)
+        except LightDBusyError as e:
+            raise RPCError(LIGHT_BUSY_CODE, str(e)) from e
+
     async def health(self) -> dict:
         return {}
+
+    # -- LightFleet routes (served only with a LightD attached) ----------
+
+    async def light_block(self, height: int | None = None) -> dict:
+        """The verified light block, whole: signed header + validator
+        set — what a re-verifying fleet client consumes."""
+        lb = await self._verified(int(height or 0))
+        return {
+            "height": str(lb.height),
+            "hash": lb.header.hash().hex(),
+            "light_block": lb.encode().hex(),
+        }
+
+    async def hop_proof(self, height: int | None = None) -> dict:
+        """The aggregate hop proof for `height` (light/fleet.HopProof
+        wire bytes): one 96-byte BLS aggregate + signer bitmap for BLS
+        committees, the per-sig form otherwise. Busy-shed maps to the
+        RPC busy contract like every other fleet read."""
+        if self.lightd is None:
+            raise RPCError(
+                -32601, "hop_proof requires a LightD serving layer"
+            )
+        from .fleet import LightDBusyError
+
+        try:
+            proof = await self.lightd.hop_proof(int(height or 0))
+        except LightDBusyError as e:
+            raise RPCError(LIGHT_BUSY_CODE, str(e)) from e
+        return {
+            "height": str(proof.height),
+            "scheme": proof.scheme,
+            "wire_bytes": str(proof.wire_bytes()),
+            "proof": proof.encode().hex(),
+        }
 
     async def _wait_for_height(self, height: int, timeout: float = 10.0) -> None:
         import asyncio
@@ -81,7 +145,8 @@ class LightProxyEnv:
 
     async def status(self) -> dict:
         res = await self.primary.status()
-        latest = self.lc.store.latest()
+        store = self.lightd.store if self.lightd is not None else self.lc.store
+        latest = store.latest()
         if latest is not None:
             # overwrite the untrusted node's claims with verified facts
             res.setdefault("sync_info", {})
@@ -90,7 +155,7 @@ class LightProxyEnv:
         return res
 
     async def commit(self, height: int | None = None) -> dict:
-        lb = await self.lc.verify_light_block_at_height(int(height or 0))
+        lb = await self._verified(int(height or 0))
         from ..rpc.core import _commit_json, _header_json
 
         return {
@@ -102,7 +167,7 @@ class LightProxyEnv:
         }
 
     async def header(self, height: int | None = None) -> dict:
-        lb = await self.lc.verify_light_block_at_height(int(height or 0))
+        lb = await self._verified(int(height or 0))
         from ..rpc.core import _header_json
 
         return {"header": _header_json(lb.header)}
@@ -110,7 +175,7 @@ class LightProxyEnv:
     async def validators(
         self, height: int | None = None, page: int = 1, per_page: int = 100
     ) -> dict:
-        lb = await self.lc.verify_light_block_at_height(int(height or 0))
+        lb = await self._verified(int(height or 0))
         from ..rpc.core import _validator_json
 
         vals = lb.validators.validators
@@ -128,7 +193,7 @@ class LightProxyEnv:
         to hash to the light-verified header (light/rpc/client.go Block)."""
         res = await self.primary.block(height=height)
         got_height = int(res["block"]["header"]["height"])
-        lb = await self.lc.verify_light_block_at_height(got_height)
+        lb = await self._verified(got_height)
         got_hash = bytes.fromhex(res["block_id"]["hash"])
         if got_hash != lb.header.hash():
             raise RPCError(
@@ -184,7 +249,7 @@ class LightProxyEnv:
         # q_height+1 — which may not exist yet at the instant of the query
         # (reference light/rpc/client.go WaitForHeight before verifying)
         await self._wait_for_height(q_height + 1)
-        lb = await self.lc.verify_light_block_at_height(q_height + 1)
+        lb = await self._verified(q_height + 1)
         value = bytes.fromhex(resp["value"])
         keypath = merkle.key_path(bytes.fromhex(resp["key"]))
         if not merkle.ProofOperators(ops).verify_value(

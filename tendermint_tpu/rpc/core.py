@@ -546,6 +546,51 @@ class Environment:
         self.evidence_pool.add_evidence(ev)
         return {"hash": _hex(ev.hash())}
 
+    # ------------------------------------------------------------------
+    # LightFleet serving surface (light/fleet.py): a full node is the
+    # provider side — it serves its own light blocks and hop proofs
+    # straight from its stores (it IS the authority for them; clients
+    # and LightDs verify). The light proxy overrides both with
+    # hop-cache-backed, verified versions.
+    # ------------------------------------------------------------------
+
+    def _light_block_at(self, height) -> tuple[int, Any]:
+        from ..light.types import LightBlock, SignedHeader
+
+        h = int(height or 0) or self.block_store.height()
+        meta = self.block_store.load_block_meta(h)
+        commit = self.block_store.load_block_commit(h)
+        if commit is None:
+            commit = self.block_store.load_seen_commit(h)
+        vals = self.state_store.load_validators(h)
+        if meta is None or commit is None or vals is None:
+            raise RPCError(-32603, f"no light block at height {h}")
+        return h, LightBlock(SignedHeader(meta.header, commit), vals)
+
+    async def light_block(self, height: int | None = None) -> dict:
+        h, lb = self._light_block_at(height)
+        return {
+            "height": str(h),
+            "hash": lb.header.hash().hex(),
+            "light_block": lb.encode().hex(),
+        }
+
+    async def hop_proof(self, height: int | None = None) -> dict:
+        """The hop proof for `height`, folded to the committee's best
+        wire form (BLS committees: one 96-byte aggregate + signer
+        bitmap; otherwise per-sig) — what a remote LightD or
+        re-verifying client consumes."""
+        from ..light.fleet import make_hop_proof
+
+        h, lb = self._light_block_at(height)
+        proof = make_hop_proof(lb)
+        return {
+            "height": str(h),
+            "scheme": proof.scheme,
+            "wire_bytes": str(proof.wire_bytes()),
+            "proof": proof.encode().hex(),
+        }
+
 
 ROUTES = [
     "health",
@@ -573,4 +618,6 @@ ROUTES = [
     "abci_info",
     "abci_query",
     "broadcast_evidence",
+    "light_block",
+    "hop_proof",
 ]

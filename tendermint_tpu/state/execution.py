@@ -94,12 +94,15 @@ def evidence_to_misbehavior(evidence: tuple, time_ns: int) -> tuple[abci.Misbeha
                 )
             )
         else:  # light-client attack evidence
-            for addr, power in getattr(ev, "byzantine_validators", ()):
+            # byzantine_validators holds Validator objects (the pool
+            # verified the attribution against its own derivation);
+            # one misbehavior entry per attributable signer
+            for val in getattr(ev, "byzantine_validators", ()):
                 out.append(
                     abci.Misbehavior(
                         type="light_client_attack",
-                        validator_address=addr,
-                        power=power,
+                        validator_address=val.address,
+                        power=val.voting_power,
                         height=ev.height,
                         time_ns=getattr(ev, "timestamp_ns", time_ns),
                         total_voting_power=getattr(ev, "total_voting_power", 0),

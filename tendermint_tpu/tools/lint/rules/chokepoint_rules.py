@@ -90,13 +90,17 @@ class VerifyChokepoint(Rule):
     #: pins batch occupancy at 1 — use `await hub.verify(...)` (or hand
     #: the work to the ingest pipeline / asyncio.to_thread). mempool/
     #: and rpc/ joined with TxIngress: the tx-flood front door lives on
-    #: the event loop and one sync verify stalls every admission
+    #: the event loop and one sync verify stalls every admission.
+    #: light/ joined with LightFleet: a LightD serves a whole client
+    #: fleet from one event loop, and one blocking verify stalls every
+    #: concurrent sync session behind a single signature
     ASYNC_SCOPES = (
         "tendermint_tpu/consensus/",
         "tendermint_tpu/blocksync/",
         "tendermint_tpu/statesync/",
         "tendermint_tpu/mempool/",
         "tendermint_tpu/rpc/",
+        "tendermint_tpu/light/",
     )
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:

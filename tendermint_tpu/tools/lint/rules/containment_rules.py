@@ -1,15 +1,20 @@
 """Containment analyzers — modules that must be structurally
 unreachable from production wiring.
 
-byz-containment: `consensus/byzantine.py` is the Byzantine
-fault-injection layer — a signer with NO double-sign guard plus a
-reactor send path that equivocates, withholds and lies on the wire. It
-exists so chaos runs can prove the protocol survives traitors; a node
-that IMPORTS it is one bad refactor away from being one. The rule pins
-the import graph: only the scenario harness (consensus/scenarios.py)
-and the module itself may name it, so `node.py`/`cli.py` can never
-reach it transitively (tests/test_byzantine.py asserts the transitive
-half on the real import graph)."""
+byz-containment: the Byzantine fault-injection layers. The rule pins
+the import graph so only the scenario harness (consensus/scenarios.py)
+and the quarantined modules themselves may name them — `node.py`/
+`cli.py` can never reach them transitively (tests/test_byzantine.py
+asserts the transitive half on the real import graph). Two modules are
+quarantined:
+
+  * `consensus/byzantine.py` — a signer with NO double-sign guard plus
+    a reactor send path that equivocates, withholds and lies on the
+    wire; a node that IMPORTS it is one bad refactor away from being a
+    traitor.
+  * `light/byzantine.py` — the lunatic provider strategy: production
+    code holding validator keys must be structurally unable to sign a
+    forged header for a light-client attack."""
 
 from __future__ import annotations
 
@@ -18,25 +23,38 @@ from typing import Iterable
 
 from ..framework import FileContext, Finding, Rule
 
-#: the quarantined module, as a dotted-path suffix
-_BYZ_SUFFIX = "consensus.byzantine"
+#: quarantined modules: dotted-path suffix -> (bare module name, files
+#: allowed to import it). The scenario harness is the single legal
+#: injection seam for both.
+_QUARANTINE: dict[str, tuple[str, tuple[str, ...]]] = {
+    "consensus.byzantine": (
+        "byzantine",
+        (
+            "tendermint_tpu/consensus/byzantine.py",
+            "tendermint_tpu/consensus/scenarios.py",
+        ),
+    ),
+    "light.byzantine": (
+        "byzantine",
+        (
+            "tendermint_tpu/light/byzantine.py",
+            "tendermint_tpu/consensus/scenarios.py",
+        ),
+    ),
+}
 
 
 class ByzContainment(Rule):
     id = "byz-containment"
     doc = (
-        "consensus/byzantine (the traitor strategy layer: unguarded "
-        "double-signing + a lying reactor send path) may only be "
-        "imported by the scenario harness and tests — production "
-        "wiring must be structurally unable to reach it"
+        "the Byzantine strategy layers (consensus/byzantine: unguarded "
+        "double-signing + a lying reactor send path; light/byzantine: "
+        "the lunatic forged-header provider) may only be imported by "
+        "the scenario harness and tests — production wiring must be "
+        "structurally unable to reach them"
     )
     scope = ("tendermint_tpu/",)
     profiles = ("node",)
-
-    ALLOWED = (
-        "tendermint_tpu/consensus/byzantine.py",
-        "tendermint_tpu/consensus/scenarios.py",
-    )
 
     def _package(self, rel: str) -> list[str]:
         """Dotted package path of the FILE's package (for resolving
@@ -65,29 +83,36 @@ class ByzContainment(Rule):
             out.append(f"{base}.{a.name}" if base else a.name)
         return out
 
+    def _quarantine_hit(self, ctx: FileContext, mod: str) -> str | None:
+        """The quarantine suffix `mod` violates from THIS file, if any."""
+        for suffix, (bare, allowed) in _QUARANTINE.items():
+            if ctx.rel in allowed:
+                continue
+            if mod.endswith(suffix) or mod == bare:
+                return suffix
+        return None
+
     def check(self, ctx: FileContext) -> Iterable[Finding]:
-        if ctx.rel in self.ALLOWED:
-            return
         for node in ast.walk(ctx.tree):
             hit = None
             if isinstance(node, ast.Import):
                 for a in node.names:
-                    if a.name.endswith(_BYZ_SUFFIX) or a.name == "byzantine":
+                    if self._quarantine_hit(ctx, a.name):
                         hit = a.name
                         break
             elif isinstance(node, ast.ImportFrom):
                 for mod in self._resolve_from(ctx, node):
-                    if mod.endswith(_BYZ_SUFFIX):
+                    if self._quarantine_hit(ctx, mod):
                         hit = mod
                         break
             if hit is not None:
                 yield ctx.finding(
                     self.id,
                     node,
-                    f"import of {hit!r}: the Byzantine strategy layer is "
+                    f"import of {hit!r}: the Byzantine strategy layers are "
                     "quarantined to the scenario harness and tests — "
-                    "production code must never be able to double-sign "
-                    "or lie on the wire",
+                    "production code must never be able to double-sign, "
+                    "lie on the wire, or forge light-client headers",
                 )
 
 

@@ -142,11 +142,19 @@ class LightClientAttackEvidence:
 
     def hash(self) -> bytes:
         # header hash + common height: the same attack reported with
-        # different byzantine attributions dedupes to one entry
-        return sha256(
-            self.conflicting_block.header.hash()
-            + self.common_height.to_bytes(8, "big")
-        )
+        # different byzantine attributions dedupes to one entry.
+        # Memoized (the DuplicateVoteEvidence pattern): the gossip
+        # reactor hashes every pending item per peer per 4 Hz tick, and
+        # an LCA hash covers a whole committee-scale header — safe on a
+        # frozen dataclass.
+        h = self.__dict__.get("_hash")
+        if h is None:
+            h = sha256(
+                self.conflicting_block.header.hash()
+                + self.common_height.to_bytes(8, "big")
+            )
+            object.__setattr__(self, "_hash", h)
+        return h
 
     def conflicting_header_is_invalid(self, trusted_header) -> bool:
         """Lunatic attack: the conflicting header fabricates one of the
@@ -195,14 +203,20 @@ class LightClientAttackEvidence:
         return out
 
     def encode(self) -> bytes:
-        out = pe.varint_field(1, self.TYPE)
-        out += pe.message_field(2, self.conflicting_block.encode())
-        out += pe.varint_field(3, self.common_height)
-        for val in self.byzantine_validators:
-            out += pe.message_field(4, val.encode())
-        out += pe.varint_field(5, self.total_voting_power)
-        out += pe.message_field(6, pe.varint_field(1, self.timestamp_ns))
-        return out
+        # memoized like hash(): an LCA encoding carries the entire
+        # conflicting light block (validator set + commit), re-encoded
+        # otherwise on every broadcast poll and pool size pass
+        enc = self.__dict__.get("_enc")
+        if enc is None:
+            enc = pe.varint_field(1, self.TYPE)
+            enc += pe.message_field(2, self.conflicting_block.encode())
+            enc += pe.varint_field(3, self.common_height)
+            for val in self.byzantine_validators:
+                enc += pe.message_field(4, val.encode())
+            enc += pe.varint_field(5, self.total_voting_power)
+            enc += pe.message_field(6, pe.varint_field(1, self.timestamp_ns))
+            object.__setattr__(self, "_enc", enc)
+        return enc
 
     @classmethod
     def decode_fields(cls, r: pe.Reader) -> "LightClientAttackEvidence":

@@ -898,6 +898,77 @@ def test_byzantine_import_allowed_in_harness_and_clean_elsewhere():
     )
 
 
+def test_sync_facade_flagged_in_light():
+    """LightFleet put light/ on the fleet-serving event loop: one
+    blocking verify in a LightD coroutine stalls every concurrent sync
+    session, so the sync facade (and direct verify) is a defect there."""
+    src = """
+    async def sync(self, height):
+        ok = self.hub.verify_sync(pk, msg, sig)
+        ok2 = self.hub.submit_nowait(pk, msg, sig).result(5.0)
+    """
+    fs = run(src, "verify-chokepoint", rel="tendermint_tpu/light/fleet.py")
+    assert len(fs) == 2
+    # sync defs in light/ stay legal (the stateless verifier core)
+    clean = """
+    def check(self, pk, msg, sig):
+        return self.hub.verify_sync(pk, msg, sig)
+    """
+    assert run(clean, "verify-chokepoint", rel="tendermint_tpu/light/verifier.py") == []
+
+
+def test_lunatic_provider_import_flagged_in_production_code():
+    """light/byzantine (the lunatic forged-header provider) is
+    quarantined exactly like consensus/byzantine: production wiring
+    holding validator keys must never be able to sign a forged header."""
+    for src, rel in (
+        ("from .light import byzantine", "tendermint_tpu/node.py"),
+        (
+            "from .light.byzantine import LunaticProvider",
+            "tendermint_tpu/node.py",
+        ),
+        (
+            "import tendermint_tpu.light.byzantine as lb",
+            "tendermint_tpu/cli.py",
+        ),
+        ("from .byzantine import LunaticConfig", "tendermint_tpu/light/fleet.py"),
+        ("from . import byzantine", "tendermint_tpu/light/proxy.py"),
+    ):
+        fs = run(src, "byz-containment", rel=rel)
+        assert len(fs) == 1, (src, rel)
+        assert "quarantined" in fs[0].message
+
+
+def test_lunatic_provider_import_allowed_in_harness_and_itself():
+    # the scenario harness is the single legal injection seam for BOTH
+    # quarantined strategy layers
+    assert (
+        run(
+            "from ..light.byzantine import LunaticConfig, LunaticProvider",
+            "byz-containment",
+            rel="tendermint_tpu/consensus/scenarios.py",
+        )
+        == []
+    )
+    assert (
+        run(
+            "from .provider import Provider",
+            "byz-containment",
+            rel="tendermint_tpu/light/byzantine.py",
+        )
+        == []
+    )
+    # unrelated light imports never trip it
+    assert (
+        run(
+            "from .light import fleet, verifier",
+            "byz-containment",
+            rel="tendermint_tpu/node.py",
+        )
+        == []
+    )
+
+
 def test_byzantine_containment_holds_on_the_real_tree():
     """The repo itself: the only files naming consensus/byzantine are
     the allowlisted harness modules (the whole-tree clean gate below
