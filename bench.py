@@ -1955,7 +1955,7 @@ def main() -> None:
     if backend != "cpu":
         from tendermint_tpu.crypto import batch as crypto_batch
 
-        crypto_batch.tpu_verifier_available(blocking=True)
+        crypto_batch.tpu_wait_available()
         try:
             extra["kernel_breakdown"] = kernel_breakdown(items)
         except Exception as e:  # noqa: BLE001

@@ -1,12 +1,20 @@
 #!/usr/bin/env python3
-"""Shim — the fs-discipline lint now lives in the tmtlint framework.
+"""Retired shim — the fs-discipline checks live in tmtlint.
 
-Equivalent to `python scripts/lint.py --rule fs-discipline`; kept so
-existing tier-1 wiring and docs referencing this script keep working.
-The AST analyzer (tendermint_tpu/tools/lint/rules/chokepoint_rules.py)
-replaces the old regex: binary write modes are read off the actual
-`open()` argument, `self.fs.open(...)` is structurally exempt, and the
-allowlist moved to tendermint_tpu/tools/lint/allowlist.json.
+This predates the PR 4 analyzer framework (it was a regex grep over
+storage files) and is now an alias for::
+
+    scripts/tmtlint --rule fs-discipline --rule transitive-fs tendermint_tpu
+
+The AST rules replace everything the regex did and more: binary write
+modes are read off the actual `open()` argument, `self.fs.open(...)` is
+structurally exempt, the allowlist lives in
+tendermint_tpu/tools/lint/allowlist.json — and `transitive-fs` also
+catches a storage path reaching a raw write through a helper in
+another file, which no single-file scan can see. That is why the scan
+surface is the WHOLE package, not the old regex's storage-path list: a
+call graph restricted to storage files has no edges into the libs/
+helper the transitive rule exists to follow.
 
 Exit status: 0 clean, 1 violations.
 """
@@ -16,20 +24,11 @@ from __future__ import annotations
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from lint import main  # noqa: E402  (scripts/lint.py)
+from tendermint_tpu.tools.lint.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    # scoped to the rule's scan surface (the old regex lint's SCAN_PREFIXES)
     sys.exit(
-        main(
-            [
-                "--rule",
-                "fs-discipline",
-                "tendermint_tpu/consensus/wal.py",
-                "tendermint_tpu/store",
-                "tendermint_tpu/state",
-            ]
-        )
+        main(["--rule", "fs-discipline", "--rule", "transitive-fs", "tendermint_tpu"])
     )

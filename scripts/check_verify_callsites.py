@@ -1,12 +1,18 @@
 #!/usr/bin/env python3
-"""Shim — the verify-chokepoint lint now lives in the tmtlint framework.
+"""Retired shim — the verify-chokepoint checks live in tmtlint.
 
-Equivalent to `python scripts/lint.py --rule verify-chokepoint`; kept so
-existing tier-1 wiring and docs referencing this script keep working.
-The AST analyzer (tendermint_tpu/tools/lint/rules/chokepoint_rules.py)
-replaces the old regex: it resolves actual `*.verify_signature(...)`
-call expressions, and the allowlist moved to
-tendermint_tpu/tools/lint/allowlist.json.
+This predates the PR 4 analyzer framework (it was a regex grep for
+`verify_signature` call sites) and is now an alias for::
+
+    scripts/tmtlint --rule verify-chokepoint --rule transitive-verify \
+        tendermint_tpu
+
+The AST rules replace everything the regex did and more: actual
+`*.verify_signature(...)` call expressions are resolved (interface
+`def`s never need special-casing), the allowlist lives in
+tendermint_tpu/tools/lint/allowlist.json — and `transitive-verify`
+also catches a coroutine reaching the hub's sync facade through a
+helper chain in other files, which the per-file scan provably misses.
 
 Exit status: 0 clean, 1 violations.
 """
@@ -16,11 +22,19 @@ from __future__ import annotations
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from lint import main  # noqa: E402  (scripts/lint.py)
+from tendermint_tpu.tools.lint.cli import main  # noqa: E402
 
 if __name__ == "__main__":
-    # scoped to the rule's scan surface: the package (matches the old
-    # regex lint; scripts/ and tests/ were never in its remit)
-    sys.exit(main(["--rule", "verify-chokepoint", "tendermint_tpu"]))
+    sys.exit(
+        main(
+            [
+                "--rule",
+                "verify-chokepoint",
+                "--rule",
+                "transitive-verify",
+                "tendermint_tpu",
+            ]
+        )
+    )

@@ -1,193 +1,19 @@
 #!/usr/bin/env python3
-"""tmtlint driver — run the project's AST invariant analyzers.
-
-Usage:
-    python scripts/lint.py                    # whole tree (tier-1 gate)
-    python scripts/lint.py --rule clock-discipline tendermint_tpu/consensus
-    python scripts/lint.py --changed          # only git-modified files
-    python scripts/lint.py --json             # machine output (+ wall time)
-    python scripts/lint.py --list-rules
-
-Exit status: 0 clean, 1 findings, 2 usage/internal error.
-
-The rules, pragma syntax (`# tmtlint: allow[rule] -- reason`) and the
-checked-in allowlist live in tendermint_tpu/tools/lint/; see the README
-"Static analysis" section for the invariant behind each rule.
+"""Legacy alias — the tmtlint driver moved to `scripts/tmtlint`
+(tendermint_tpu/tools/lint/cli.py) when the suite grew the
+interprocedural and wire-schema passes. Kept so existing wiring and
+docs referencing `scripts/lint.py` keep working; both names run the
+same `main()` — one code path, no drift.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
-import subprocess
 import sys
-import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from tendermint_tpu.tools.lint import (  # noqa: E402
-    ALL_RULES,
-    DEFAULT_ALLOWLIST,
-    RULES_BY_ID,
-    Allowlist,
-    lint_paths,
-)
-
-DEFAULT_PATHS = ["tendermint_tpu", "scripts", "tests"]
-
-
-def changed_files() -> list[str]:
-    """Working-tree changes vs HEAD plus untracked files — the fast
-    pre-commit surface."""
-    out = subprocess.run(
-        ["git", "diff", "--name-only", "HEAD"],
-        cwd=REPO,
-        capture_output=True,
-        text=True,
-        check=True,
-    ).stdout.splitlines()
-    untracked = subprocess.run(
-        ["git", "ls-files", "--others", "--exclude-standard"],
-        cwd=REPO,
-        capture_output=True,
-        text=True,
-        check=True,
-    ).stdout.splitlines()
-    return [
-        p
-        for p in dict.fromkeys(out + untracked)
-        if p.endswith(".py") and os.path.exists(os.path.join(REPO, p))
-    ]
-
-
-def main(argv: list[str] | None = None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("paths", nargs="*", help=f"files/dirs (default: {DEFAULT_PATHS})")
-    ap.add_argument(
-        "--rule",
-        action="append",
-        default=[],
-        metavar="ID",
-        help="run only these rule ids (repeatable)",
-    )
-    ap.add_argument("--json", action="store_true", help="machine-readable output")
-    ap.add_argument(
-        "--changed",
-        action="store_true",
-        help="lint only files modified vs HEAD (plus untracked), "
-        "restricted to the positional paths (default: the tier-1 scan "
-        "surface, so pre-commit and the gate agree)",
-    )
-    ap.add_argument("--list-rules", action="store_true")
-    ap.add_argument(
-        "--allowlist",
-        default=DEFAULT_ALLOWLIST,
-        help="path to the allowlist JSON (default: checked-in)",
-    )
-    args = ap.parse_args(argv)
-
-    if args.list_rules:
-        for r in ALL_RULES:
-            scope = ", ".join(r.scope) if r.scope else "everywhere"
-            print(f"{r.id:22s} [{'/'.join(r.profiles)}] {r.doc}")
-            print(f"{'':22s} scope: {scope}")
-        return 0
-
-    rules = list(ALL_RULES)
-    if args.rule:
-        unknown = [r for r in args.rule if r not in RULES_BY_ID]
-        if unknown:
-            print(f"unknown rule id(s): {', '.join(unknown)}", file=sys.stderr)
-            print(f"known: {', '.join(sorted(RULES_BY_ID))}", file=sys.stderr)
-            return 2
-        rules = [RULES_BY_ID[r] for r in args.rule]
-
-    # a typo'd path must be a usage error, not a 0-file "clean" — the
-    # silent-miss class this linter exists to prevent
-    missing = [
-        p
-        for p in args.paths
-        if not os.path.exists(p if os.path.isabs(p) else os.path.join(REPO, p))
-    ]
-    if missing:
-        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
-        return 2
-
-    if args.changed:
-        # intersect with the gate's scan surface (or the named paths):
-        # pre-commit must never fail on files the tier-1 gate ignores,
-        # or pass on files it checks
-        scope = [
-            os.path.relpath(p, REPO).replace(os.sep, "/")
-            if os.path.isabs(p)
-            else p.rstrip("/")
-            for p in (args.paths or DEFAULT_PATHS)
-        ]
-        paths = [
-            f
-            for f in changed_files()
-            if any(f == s or f.startswith(s + "/") for s in scope)
-        ]
-        if not paths:
-            if args.json:
-                print(json.dumps({"findings": [], "files_scanned": 0,
-                                  "rules": [r.id for r in rules],
-                                  "elapsed_s": 0.0, "clean": True}))
-            else:
-                print("tmtlint: no changed python files")
-            return 0
-    else:
-        paths = args.paths or DEFAULT_PATHS
-
-    allowlist = Allowlist.load(args.allowlist)
-    t0 = time.monotonic()
-    # bad-pragma findings belong to the full gate; a single-rule run
-    # (the shims, --rule spot checks) reports only its own rule
-    findings, n_files = lint_paths(
-        paths, rules, allowlist, REPO, report_pragma_errors=not args.rule
-    )
-    elapsed = time.monotonic() - t0
-
-    if args.json:
-        print(
-            json.dumps(
-                {
-                    "findings": [f.to_json() for f in findings],
-                    "files_scanned": n_files,
-                    "rules": [r.id for r in rules],
-                    "elapsed_s": round(elapsed, 3),
-                    "clean": not findings,
-                },
-                indent=2,
-            )
-        )
-        return 1 if findings else 0
-
-    if not findings:
-        print(
-            f"tmtlint: clean — {n_files} files, {len(rules)} rules, "
-            f"{elapsed * 1e3:.0f} ms"
-        )
-        return 0
-    print(
-        f"tmtlint: {len(findings)} finding(s) across {n_files} files "
-        f"({elapsed * 1e3:.0f} ms):",
-        file=sys.stderr,
-    )
-    for f in findings:
-        print(f"  {f.render()}", file=sys.stderr)
-        if f.snippet:
-            print(f"      {f.snippet}", file=sys.stderr)
-    print(
-        "\nfix the call site, or annotate an intentional one with\n"
-        "  # tmtlint: allow[rule-id] -- reason\n"
-        "(see README 'Static analysis' for each rule's invariant)",
-        file=sys.stderr,
-    )
-    return 1
-
+from tendermint_tpu.tools.lint.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -22,6 +22,14 @@ class CheckTxType(enum.IntEnum):
 
 CODE_TYPE_OK = 0
 
+# Wire-side sanity bounds. ABCI frames usually come from the node's own
+# app, but the same decoders run over the remote-socket client AND over
+# the state store's durable bytes (where chaos bit-rot applies): a
+# corrupt repeat-count must raise ValueError, never allocate
+# (tmtlint wire-bounds; the RouterNet corrupt-frame class).
+MAX_WIRE_EVENTS = 1 << 16
+MAX_WIRE_EVENT_ATTRS = 1 << 16
+
 
 # --------------------------------------------------------------------------
 # events (reference abci/types/types.pb.go Event/EventAttribute)
@@ -80,6 +88,10 @@ class Event:
                 type_ = r.read_bytes().decode()
             elif f == 2:
                 attrs.append(EventAttribute.decode(r.read_bytes()))
+                if len(attrs) > MAX_WIRE_EVENT_ATTRS:
+                    raise ValueError(
+                        f"event attributes exceed {MAX_WIRE_EVENT_ATTRS}"
+                    )
             else:
                 r.skip(wt)
         return cls(type_, tuple(attrs))
@@ -433,6 +445,10 @@ class ResponseDeliverTx:
                 kw["gas_used"] = r.read_uvarint()
             elif f == 6:
                 events.append(Event.decode(r.read_bytes()))
+                if len(events) > MAX_WIRE_EVENTS:
+                    raise ValueError(
+                        f"deliver-tx events exceed {MAX_WIRE_EVENTS}"
+                    )
             elif f == 7:
                 kw["codespace"] = r.read_bytes().decode()
             else:

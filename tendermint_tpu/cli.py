@@ -197,7 +197,10 @@ def _build_node(home: str):
 
 
 async def _run_node(home: str) -> None:
-    node, cfg, transport = _build_node(home)
+    # _build_node is pure construction (config/genesis file reads,
+    # sqlite opens) — blocking I/O, so it runs off-loop; nothing here
+    # needs the loop until transport.listen below
+    node, cfg, transport = await asyncio.to_thread(_build_node, home)
     await transport.listen(cfg.p2p.laddr)
     await node.start()
     for peer in filter(None, cfg.p2p.persistent_peers.split(",")):

@@ -389,23 +389,23 @@ def _round_states(net: RouterNet) -> list[dict]:
     return out
 
 
-def _dump_wedge(
+def _snapshot_wedge(
     scenario: Scenario,
     net: RouterNet,
     chaos: ChaosNetwork | None,
-    dump_dir: str,
     detail: dict,
-) -> str:
-    """Auto-dump on wedge: flight recorder ring (when tracing is on)
-    plus a JSON snapshot of per-class chaos fault counters and every
-    node's round state — the post-mortem the 150-validator soak promises
-    (acceptance: any wedge is diagnosable from disk)."""
+) -> dict:
+    """Build the wedge post-mortem payload ON THE LOOP: the routers are
+    still live here (run_scenario stops them after the dump so round
+    state is readable), so fault counters and round states must be
+    copied in one loop step — iterating them from a worker thread races
+    their writers (dict-changed-size mid-dump). The flight ring is
+    dumped here too (its own small file; the recorder's state is
+    loop-mutated)."""
     from ..libs import trace
 
-    os.makedirs(dump_dir, exist_ok=True)
     flight = trace.auto_dump(f"chaos-wedge-{scenario.name}")
-    path = os.path.join(dump_dir, f"chaos-wedge-{scenario.name}.json")
-    payload = {
+    return {
         "scenario": scenario.name,
         "summary": scenario.summary,
         "faults": dict(chaos.faults) if chaos is not None else {},
@@ -418,6 +418,15 @@ def _dump_wedge(
         "flight_dump": flight or "",
         **detail,
     }
+
+
+def _write_wedge(dump_dir: str, name: str, payload: dict) -> str:
+    """Write the (already-snapshotted) payload — the blocking half,
+    pushed off the loop via asyncio.to_thread so a slow disk cannot
+    stall the routers the dump describes (acceptance: any wedge is
+    diagnosable from disk)."""
+    os.makedirs(dump_dir, exist_ok=True)
+    path = os.path.join(dump_dir, f"chaos-wedge-{name}.json")
     with open(path, "w", encoding="utf-8") as f:
         json.dump(payload, f, indent=2, default=str)
     return path
@@ -618,11 +627,13 @@ async def run_scenario(
         except Exception as e:  # noqa: BLE001 — observation must not mask
             audit = {"ok": False, "notes": [f"audit failed: {e!r}"]}
         if wedged or error:
-            dump_path = _dump_wedge(
+            # snapshot on the loop (atomic view of live state), write
+            # off the loop (a slow disk can't stall the routers the
+            # dump describes)
+            payload = _snapshot_wedge(
                 scenario,
                 net,
                 chaos,
-                dump_dir,
                 {
                     "seed": seed,
                     "n_vals": n_vals,
@@ -633,6 +644,9 @@ async def run_scenario(
                     "byz": byz_actions,
                     "audit": audit,
                 },
+            )
+            dump_path = await asyncio.to_thread(
+                _write_wedge, dump_dir, scenario.name, payload
             )
         await net.stop()
     if event_err and not error:

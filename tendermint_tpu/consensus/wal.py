@@ -11,7 +11,8 @@ Files: `wal` is the head; at `head_size_limit` it rotates to `wal.000`,
 order, then the head.
 
 Crash consistency: all file I/O goes through an injectable `libs.chaosfs.FS`
-(lint-enforced by scripts/check_fs_callsites.py) so storage faults — torn
+(lint-enforced by the tmtlint fs-discipline + transitive-fs rules,
+`scripts/tmtlint`) so storage faults — torn
 writes, lost fsyncs, ENOSPC mid-record, bit-rot — are testable. On open,
 `repair()` scans every file and truncates to the last whole record,
 moving any damaged tail aside into `<file>.corrupt.<n>` instead of

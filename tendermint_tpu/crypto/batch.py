@@ -223,14 +223,16 @@ def _measure_cutoff() -> None:
     )
 
 
-def tpu_verifier_available(*, blocking: bool = False) -> bool:
+def tpu_verifier_available() -> bool:
     """True when the JAX backend is up AND the kernel is warmed.
 
     Backend init + first compile can take minutes (TPU tunnel, large
     kernel), so the probe runs on a daemon thread and this returns False
-    — routing batches to the host verifier — until it finishes. Pass
-    blocking=True (benchmarks) to wait for the probe. Disable with
-    TMTPU_DISABLE_TPU=1."""
+    — routing batches to the host verifier — until it finishes. NEVER
+    blocks (coroutines call it to kick the probe: the tmtlint
+    transitive-blocking pass holds this structurally — the wait loop
+    lives in `tpu_wait_available`, which no async path may reach).
+    Disable with TMTPU_DISABLE_TPU=1."""
     global _tpu_probe_started
     if _tpu_available is not None:
         return _tpu_available
@@ -241,13 +243,23 @@ def tpu_verifier_available(*, blocking: bool = False) -> bool:
             _tpu_probe_started = True
             t = threading.Thread(target=_probe_tpu, name="tpu-probe", daemon=True)
             t.start()
-    if blocking:
-        while _tpu_available is None:
-            import time
-
-            time.sleep(0.1)
-        return _tpu_available
     return False if _tpu_available is None else _tpu_available
+
+
+def tpu_wait_available() -> bool:
+    """Blocking companion of `tpu_verifier_available`: kick the probe
+    and WAIT for its verdict. Benchmarks/tools only — never call from
+    a coroutine (or anything a coroutine calls)."""
+    tpu_verifier_available()  # start the probe thread if needed
+    if os.environ.get("TMTPU_DISABLE_TPU") and _tpu_available is None:
+        return False
+    import time
+
+    # always re-read the global: the probe may land between the kick
+    # above and here, and this function's contract is the FINAL verdict
+    while _tpu_available is None:
+        time.sleep(0.1)
+    return _tpu_available
 
 
 # Below this many signatures the TPU round-trip (host transfer + launch

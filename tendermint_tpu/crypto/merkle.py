@@ -14,6 +14,12 @@ from .hashes import sha256
 LEAF_PREFIX = b"\x00"
 INNER_PREFIX = b"\x01"
 
+# Proofs arrive from untrusted peers (light client, statesync): depth is
+# logarithmic in tree size, so anything past 100 aunts (reference
+# crypto/merkle/proof.go MaxAunts, a 2^100-leaf tree) is malformed by
+# construction — raise at decode, never allocate (tmtlint wire-bounds).
+MAX_PROOF_AUNTS = 100
+
 
 def _leaf_hash(leaf: bytes) -> bytes:
     return sha256(LEAF_PREFIX + leaf)
@@ -89,6 +95,10 @@ class Proof:
                 leaf_hash = r.read_bytes()
             elif field == 4:
                 aunts.append(r.read_bytes())
+                if len(aunts) > MAX_PROOF_AUNTS:
+                    raise ValueError(
+                        f"merkle proof aunts exceed {MAX_PROOF_AUNTS}"
+                    )
             else:
                 r.skip(wt)
         return cls(total=total, index=index, leaf_hash=leaf_hash, aunts=aunts)

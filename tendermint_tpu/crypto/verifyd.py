@@ -215,6 +215,18 @@ def encode_stats_ok(req_id: int, payload: dict) -> bytes:
     )
 
 
+# A 32 MiB frame (MAX_FRAME) can carry at most ~16M one-byte repeated
+# fields, but a list of tiny decoded items amplifies memory well past
+# the frame budget — clamp every repeat count explicitly. The in-flight
+# cap sheds real batches far below this; the bound only exists so a
+# hostile/corrupt frame raises instead of allocating.
+MAX_REPEATED = 1 << 20
+
+
+#: repeated-field clamp — the shared codec checker with this module's bound
+_check_repeat = pe.check_repeat
+
+
 def decode_message(data: bytes) -> tuple[int, dict]:
     """Decode one frame payload into (msg_type, fields). Unknown fields
     are skipped (forward compatibility); repeated fields collect into
@@ -246,8 +258,10 @@ def decode_message(data: bytes) -> tuple[int, dict]:
         elif f == 3:
             if msg_type == MSG_HELLO_OK:
                 out["schemes"].append(r.read_string())
+                _check_repeat(out["schemes"], MAX_REPEATED, "schemes")
             elif msg_type == MSG_VERIFY_BATCH:
                 out["items"].append(_decode_item(r.read_bytes()))
+                _check_repeat(out["items"], MAX_REPEATED, "items")
             elif msg_type == MSG_VERIFY_AGGREGATE:
                 kr = pe.Reader(r.read_bytes())
                 kt, pk = "", b""
@@ -260,6 +274,7 @@ def decode_message(data: bytes) -> tuple[int, dict]:
                     else:
                         kr.skip(kwt)
                 out["keys"].append((kt, pk))
+                _check_repeat(out["keys"], MAX_REPEATED, "keys")
             elif msg_type == MSG_VERDICTS:
                 out["verdicts"] = [bool(b) for b in r.read_bytes()]
             elif msg_type == MSG_ERROR:
@@ -271,8 +286,10 @@ def decode_message(data: bytes) -> tuple[int, dict]:
         elif f == 4:
             if msg_type == MSG_HELLO_OK:
                 out["ladder"].append(r.read_uvarint())
+                _check_repeat(out["ladder"], MAX_REPEATED, "ladder")
             elif msg_type == MSG_VERIFY_AGGREGATE:
                 out["msgs"].append(r.read_bytes())
+                _check_repeat(out["msgs"], MAX_REPEATED, "msgs")
             else:
                 r.skip(wt)
         elif f == 5:

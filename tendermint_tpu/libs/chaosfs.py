@@ -4,8 +4,9 @@
 covers the disk half — the write path under `consensus/wal.py`,
 `store/blockstore.py`, `store/db.py`, and `state/store.py`. It is both
 the **injectable I/O layer** those subsystems are required to use (the
-`check_fs_callsites.py` lint forbids raw `open(.., "wb")`/`os.fsync`
-there) and the fault controller that perturbs it.
+tmtlint `fs-discipline` rule forbids raw `open(.., "wb")`/`os.fsync`
+there, and `transitive-fs` forbids reaching one through a helper in
+another file) and the fault controller that perturbs it.
 
 Fault classes (all per-operation, all drawn from ONE seeded RNG so a
 fault schedule is reproducible):

@@ -94,6 +94,17 @@ def len_prefixed(encoded: bytes) -> bytes:
     return uvarint(len(encoded)) + encoded
 
 
+def check_repeat(items, bound: int, what: str) -> None:
+    """Clamp a repeated-field collection at decode. Wire frames arrive
+    from untrusted peers (and durable bytes see chaos bit-rot), so a
+    corrupt repeat count must raise, never allocate — the shared
+    checker every decode loop calls with its module's named ``MAX_*``
+    bound (the tmtlint wire-bounds rule recognizes the call as the
+    clamp)."""
+    if len(items) > bound:
+        raise ValueError(f"wire frame repeats {what} beyond {bound}")
+
+
 class Reader:
     """Minimal wire-format reader for decoding our own encodings."""
 

@@ -29,6 +29,13 @@ from .pool import PriorityMempool, TxInCacheError, TxRejectedError
 
 BROADCAST_SLEEP = 0.05
 
+# Wire-side sanity bound: a gossip frame is sent one-tx-at-a-time by
+# honest peers (see _broadcast_routine), so a frame repeating thousands
+# of tx fields is malformed by construction — raise at decode, never
+# build an unbounded list (tmtlint wire-bounds; the decoded txs also
+# each pass through the ingress size/occupancy checks afterwards).
+MAX_WIRE_TXS = 1024
+
 
 def encode_txs(txs: list[bytes]) -> bytes:
     return b"".join(pe.bytes_field(1, tx) for tx in txs)
@@ -41,6 +48,8 @@ def decode_txs(data: bytes) -> list[bytes]:
         f, wt = r.read_tag()
         if f == 1:
             out.append(r.read_bytes())
+            if len(out) > MAX_WIRE_TXS:
+                raise ValueError(f"tx gossip frame exceeds {MAX_WIRE_TXS} txs")
         else:
             r.skip(wt)
     return out

@@ -52,6 +52,14 @@ def decode_message(data: bytes):
             bf, bwt = br.read_tag()
             if bf == 1:
                 addrs.append(br.read_string())
+                # honest responders cap at MAX_ADDRESSES (see
+                # _handle_request); a frame past it is malformed, and
+                # an unbounded list here would let one hostile peer
+                # stuff the address book allocator
+                if len(addrs) > MAX_ADDRESSES:
+                    raise ValueError(
+                        f"pex response exceeds {MAX_ADDRESSES} addresses"
+                    )
             else:
                 br.skip(bwt)
         return PexResponse(tuple(addrs))

@@ -24,6 +24,16 @@ def _hkey(prefix: bytes, height: int) -> bytes:
     return prefix + height.to_bytes(8, "big")
 
 
+# Durable bytes are the node's own writes, but chaos bit-rot applies to
+# the DB file like to any other storage — a corrupted repeat count must
+# raise at decode, never allocate (tmtlint wire-bounds).
+MAX_STORE_ITEMS = 1 << 20
+
+
+#: repeated-field clamp — the shared codec checker with this module's bound
+_check_items = pe.check_repeat
+
+
 class ABCIResponses:
     """The app's responses to one block (reference tmstate.ABCIResponses)."""
 
@@ -78,16 +88,19 @@ class ABCIResponses:
             f, wt = r.read_tag()
             if f == 1:
                 txs.append(abci.ResponseDeliverTx.decode(r.read_bytes()))
+                _check_items(txs, MAX_STORE_ITEMS, "deliver-txs")
             elif f == 2:
                 rr = pe.Reader(r.read_bytes())
                 while not rr.eof():
                     ff, wwt = rr.read_tag()
                     if ff == 1:
                         updates.append(abci.ValidatorUpdate.decode(rr.read_bytes()))
+                        _check_items(updates, MAX_STORE_ITEMS, "validator-updates")
                     elif ff == 2:
                         param_updates = ConsensusParams.decode(rr.read_bytes())
                     elif ff == 3:
                         eb_events.append(abci.Event.decode(rr.read_bytes()))
+                        _check_items(eb_events, MAX_STORE_ITEMS, "end-block events")
                     else:
                         rr.skip(wwt)
             elif f == 3:
@@ -96,6 +109,7 @@ class ABCIResponses:
                     ff, wwt = rr.read_tag()
                     if ff == 1:
                         bb_events.append(abci.Event.decode(rr.read_bytes()))
+                        _check_items(bb_events, MAX_STORE_ITEMS, "begin-block events")
                     else:
                         rr.skip(wwt)
             else:

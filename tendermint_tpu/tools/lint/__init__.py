@@ -6,7 +6,8 @@ Public surface:
   * `lint_paths` / `lint_source` — run rules over files or a source blob
   * `Finding`, `Rule`, `FileContext`, `Allowlist` — extension points
 
-Driver: `scripts/lint.py` (text/JSON output, --rule, --changed).
+Driver: `scripts/tmtlint` (text/JSON output, --rule, --changed,
+--update-lock; `scripts/lint.py` is the legacy alias).
 Invariant docs: README "Static analysis".
 """
 
@@ -17,8 +18,11 @@ from .framework import (  # noqa: F401
     Allowlist,
     FileContext,
     Finding,
+    ProjectContext,
+    ProjectRule,
     Rule,
     lint_paths,
     lint_source,
+    lint_tree,
 )
 from .rules import ALL_RULES, RULES_BY_ID  # noqa: F401

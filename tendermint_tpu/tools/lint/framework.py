@@ -252,7 +252,14 @@ class FileContext:
             for p in self.pragmas.get(finding.line, ())
         )
 
-    def pragma_errors(self) -> list[Finding]:
+    def pragma_errors(
+        self, known_rules: frozenset[str] | set[str] | None = None
+    ) -> list[Finding]:
+        """Malformed pragmas. With `known_rules` (the registered rule id
+        set), a pragma naming an id that does not exist is also a
+        finding — a typo'd ``allow[shape-bucketting]`` suppresses
+        nothing, reports nothing, and silently rots until the real rule
+        fires in CI; make the typo itself fail."""
         self.pragmas  # ensure _pragma_raw is populated
         out = []
         for p in self._pragma_raw:
@@ -279,7 +286,31 @@ class FileContext:
                         self.line_text(p.line),
                     )
                 )
+            if known_rules is not None:
+                for rid in sorted(p.rules - {"*", BAD_PRAGMA} - set(known_rules)):
+                    out.append(
+                        Finding(
+                            BAD_PRAGMA,
+                            self.rel,
+                            p.line,
+                            1,
+                            f"pragma names unknown rule id {rid!r} — it "
+                            "suppresses nothing (check --list-rules for the "
+                            "registered ids)",
+                            self.line_text(p.line),
+                        )
+                    )
         return out
+
+    def line_suppressed(self, rule_ids: Iterable[str], line: int) -> bool:
+        """True when any pragma on `line` (with a reason) names one of
+        `rule_ids` or the wildcard — the per-line half of suppression,
+        reusable by project rules checking lines in OTHER files."""
+        ids = set(rule_ids)
+        return any(
+            p.reason is not None and ("*" in p.rules or (ids & p.rules))
+            for p in self.pragmas.get(line, ())
+        )
 
 
 class Rule:
@@ -302,6 +333,25 @@ class Rule:
         return any(rel.startswith(p) for p in self.scope)
 
     def check(self, ctx: FileContext) -> Iterable[Finding]:  # override
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    """A tree-wide analyzer: runs ONCE per lint invocation over the
+    `ProjectContext` (every parsed file, the import graph, the resolved
+    call graph) instead of once per file. Pragmas, the allowlist and
+    profiles still apply — a project finding lands on a concrete
+    (path, line) and is suppressed/exempted exactly like a per-file
+    one. `lint_source` skips project rules (a single blob has no
+    project); fixtures drive them through `lint_tree`."""
+
+    def applies_to(self, rel: str, profile: str) -> bool:  # per-file dispatch
+        return False
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, pctx: "ProjectContext") -> Iterable[Finding]:
         raise NotImplementedError
 
 
@@ -365,6 +415,364 @@ class Allowlist:
 DEFAULT_ALLOWLIST = os.path.join(os.path.dirname(__file__), "allowlist.json")
 
 
+# -- project context (tree-wide import + call graph) ---------------------
+
+
+@dataclass
+class FuncInfo:
+    """One function the call graph knows: a module-level def or a class
+    method (qualname "f" / "Cls.f"). Nested defs are deliberately NOT
+    nodes — they run in their own frame, and a call-graph edge into one
+    would claim the enclosing function executes its body."""
+
+    key: str  # "<rel>::<qualname>"
+    rel: str
+    qualname: str
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+    cls: str | None  # enclosing class name, for `self.x()` resolution
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+
+def _const_int(node: ast.expr, env: dict[str, int]) -> int | None:
+    """Fold a module-level constant int expression: literals, names
+    already bound in `env`, +,-,*,//,<<,| — everything the wire tags
+    and MAX_* bounds actually use. Non-constant -> None."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, int) and not isinstance(node.value, bool):
+            return node.value
+        return None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand, env)
+        return None if v is None else -v
+    if isinstance(node, ast.BinOp):
+        left = _const_int(node.left, env)
+        right = _const_int(node.right, env)
+        if left is None or right is None:
+            return None
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if isinstance(node.op, ast.FloorDiv) and right:
+            return left // right
+        if isinstance(node.op, ast.LShift) and 0 <= right < 256:
+            return left << right
+        if isinstance(node.op, ast.BitOr):
+            return left | right
+    return None
+
+
+def _same_frame_body(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested def/lambda bodies
+    (those execute in a different frame)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _same_frame_nodes(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> Iterator[ast.AST]:
+    """Same-frame walk of a function's body."""
+    yield from _same_frame_body(fn.body)
+
+
+class ProjectContext:
+    """Everything the interprocedural rules need, built once per run:
+
+      * every `FileContext` in the scan surface (`files`),
+      * a per-file ABSOLUTE import table (relative imports resolved
+        against the file's package, unlike `FileContext.import_aliases`),
+      * a function index over module-level defs and class methods,
+      * name-resolved call edges between them (`calls_of`), and
+      * a generic memoized reachability search (`find_witness`) that
+        rules parameterize with a direct-hit predicate.
+
+    Resolution is deliberately conservative: a call target the table
+    cannot pin to exactly one in-tree function is simply not an edge.
+    A missed edge costs recall, never a false finding.
+    """
+
+    def __init__(self, files: dict[str, FileContext], *, full_tree: bool = False):
+        self.files = files
+        #: True when the scan surface covers the whole package — gates
+        #: checks that compare the TREE against global state (lockfile
+        #: staleness, cross-file channel-tag collisions) and would
+        #: misfire on a partial scan
+        self.full_tree = full_tree
+        #: the run's Allowlist (set by the runner): rules consult it so
+        #: whole-file exemptions double as SINKS — a chain is pruned at
+        #: an exempted file instead of reporting through it
+        self.allowlist: Allowlist = Allowlist()
+        self._module_to_rel: dict[str, str] = {}
+        for rel in files:
+            if not rel.endswith(".py"):
+                continue
+            if rel.endswith("/__init__.py"):
+                dotted = rel[: -len("/__init__.py")].replace("/", ".")
+            else:
+                dotted = rel[:-3].replace("/", ".")
+            self._module_to_rel[dotted] = rel
+        self._imports: dict[str, dict[str, str]] = {}
+        self._funcs: dict[str, FuncInfo] | None = None
+        self._class_bases: dict[str, dict[str, list[str]]] = {}
+        self._constants: dict[str, dict[str, int]] = {}
+        self._edges: dict[str, list[tuple[str, int]]] = {}
+
+    # -- imports --------------------------------------------------------
+
+    def imports_of(self, rel: str) -> dict[str, str]:
+        """local binding -> absolute dotted target (module or
+        module.member). Handles `import a.b as x`, `from a.b import c`
+        AND relative `from ..libs import protoenc as pe` forms."""
+        cached = self._imports.get(rel)
+        if cached is not None:
+            return cached
+        table: dict[str, str] = {}
+        ctx = self.files.get(rel)
+        if ctx is not None:
+            pkg = rel.split("/")[:-1]
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        if a.asname:
+                            table[a.asname] = a.name
+                        else:
+                            # `import a.b.c` binds only the head name
+                            head = a.name.split(".")[0]
+                            table[head] = head
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level == 0:
+                        base = node.module or ""
+                    else:
+                        up = node.level - 1
+                        anchor = pkg[: len(pkg) - up] if up else pkg
+                        base = ".".join(anchor)
+                        if node.module:
+                            base = f"{base}.{node.module}" if base else node.module
+                    for a in node.names:
+                        if a.name == "*":
+                            continue
+                        target = f"{base}.{a.name}" if base else a.name
+                        table[a.asname or a.name] = target
+        self._imports[rel] = table
+        return table
+
+    # -- function index -------------------------------------------------
+
+    @property
+    def funcs(self) -> dict[str, FuncInfo]:
+        if self._funcs is None:
+            self._funcs = {}
+            for rel, ctx in self.files.items():
+                bases: dict[str, list[str]] = {}
+                for stmt in ctx.tree.body:
+                    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        info = FuncInfo(f"{rel}::{stmt.name}", rel, stmt.name, stmt, None)
+                        self._funcs[info.key] = info
+                    elif isinstance(stmt, ast.ClassDef):
+                        bases[stmt.name] = [
+                            b.id for b in stmt.bases if isinstance(b, ast.Name)
+                        ]
+                        for sub in stmt.body:
+                            if isinstance(
+                                sub, (ast.FunctionDef, ast.AsyncFunctionDef)
+                            ):
+                                q = f"{stmt.name}.{sub.name}"
+                                info = FuncInfo(
+                                    f"{rel}::{q}", rel, q, sub, stmt.name
+                                )
+                                self._funcs[info.key] = info
+                self._class_bases[rel] = bases
+        return self._funcs
+
+    def constants_of(self, rel: str) -> dict[str, int]:
+        """Module-level `NAME = <int expr>` bindings (wire tags, channel
+        ids, MAX_* bounds live here) — simple constant arithmetic like
+        ``1 << 20`` or ``32 * 1024 * 1024`` is folded."""
+        cached = self._constants.get(rel)
+        if cached is not None:
+            return cached
+        table: dict[str, int] = {}
+        ctx = self.files.get(rel)
+        if ctx is not None:
+            for stmt in ctx.tree.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                ):
+                    value = _const_int(stmt.value, table)
+                    if value is not None:
+                        table[stmt.targets[0].id] = value
+        self._constants[rel] = table
+        return table
+
+    def resolve_constant(self, rel: str, name: str) -> tuple[str, int] | None:
+        """Resolve `name` (a bare Name used in wire position in `rel`)
+        to ("NAME", value) — locally defined, or followed through one
+        `from x import NAME` hop."""
+        local = self.constants_of(rel)
+        if name in local:
+            return name, local[name]
+        target = self.imports_of(rel).get(name)
+        if target and "." in target:
+            mod, _, attr = target.rpartition(".")
+            mrel = self._module_to_rel.get(mod)
+            if mrel is not None:
+                other = self.constants_of(mrel)
+                if attr in other:
+                    return attr, other[attr]
+        return None
+
+    # -- call resolution -------------------------------------------------
+
+    def _func_key_for_dotted(self, dotted: str) -> str | None:
+        mod, _, fn = dotted.rpartition(".")
+        rel = self._module_to_rel.get(mod)
+        if rel is None:
+            return None
+        key = f"{rel}::{fn}"
+        return key if key in self.funcs else None
+
+    def _resolve_method(self, info: FuncInfo, meth: str) -> str | None:
+        """`self.meth()` inside a method: the class itself, then
+        same-file single-level bases."""
+        if info.cls is None:
+            return None
+        seen: list[str] = [info.cls]
+        seen.extend(self._class_bases.get(info.rel, {}).get(info.cls, ()))
+        for cls in seen:
+            key = f"{info.rel}::{cls}.{meth}"
+            if key in self.funcs:
+                return key
+        return None
+
+    def resolve_call_target(self, info: FuncInfo, node: ast.Call) -> str | None:
+        """The in-tree FuncInfo key a call statically resolves to, or
+        None. Covers: local defs, `from m import f` / `import m; m.f()`
+        (absolute or relative), and `self.meth()` within a class."""
+        name = call_name(node)
+        if name is None:
+            return None
+        parts = name.split(".")
+        imports = self.imports_of(info.rel)
+        if len(parts) == 1:
+            n = parts[0]
+            if n in imports:
+                return self._func_key_for_dotted(imports[n])
+            key = f"{info.rel}::{n}"
+            return key if key in self.funcs else None
+        if parts[0] == "self" and len(parts) == 2:
+            return self._resolve_method(info, parts[1])
+        if parts[0] in imports and len(parts) == 2:
+            target = imports[parts[0]]
+            # `import m` / `from pkg import m` then m.f()
+            return self._func_key_for_dotted(f"{target}.{parts[1]}")
+        return None
+
+    def calls_of(self, key: str) -> list[tuple[str, int]]:
+        """Resolved same-frame call edges of a function:
+        [(callee_key, call_lineno)]."""
+        cached = self._edges.get(key)
+        if cached is not None:
+            return cached
+        info = self.funcs[key]
+        out: list[tuple[str, int]] = []
+        for node in _same_frame_nodes(info.node):
+            if isinstance(node, ast.Call):
+                callee = self.resolve_call_target(info, node)
+                if callee is not None and callee != key:
+                    out.append((callee, node.lineno))
+        self._edges[key] = out
+        return out
+
+    # -- reachability -----------------------------------------------------
+
+    def find_witness(
+        self,
+        start: str,
+        direct_hits,
+        *,
+        rule_ids: tuple[str, ...],
+        hop_ok=None,
+        memo: dict | None = None,
+    ) -> tuple | None:
+        """Depth-first search for a 'witness': the shortest-found chain
+        `((key, line, desc), ..., (key, line, desc))` from `start` to a
+        direct hit. `direct_hits(info) -> [(line, desc)]` names the
+        primitive the rule hunts; `hop_ok(info) -> bool` prunes callees
+        (e.g. never traverse into crypto/ for the verify funnel).
+        Pragma-suppressed hit lines and edge lines (any id in
+        `rule_ids`) do not count — an annotated intermediate hop
+        breaks the chain for the whole tree, which is exactly the
+        auditable-suppression contract."""
+        if memo is None:
+            memo = {}
+        rule_set = tuple(rule_ids)
+
+        def dfs(key: str, stack: frozenset) -> tuple[tuple | None, bool]:
+            """(witness, exhaustive): a negative answer is only cached
+            when the search under `key` was EXHAUSTIVE — a branch pruned
+            because its callee sat on the current DFS stack says nothing
+            about that callee's witness from a different entry point,
+            and memoizing the truncated None would poison every later
+            query through it (a false negative in all chain rules)."""
+            if key in memo:
+                return memo[key], True
+            if key in stack:
+                return None, False  # cycle: truncated, not exhaustive
+            info = self.funcs[key]
+            ctx = self.files[info.rel]
+            for line, desc in direct_hits(info):
+                if not ctx.line_suppressed(rule_set, line):
+                    chain = ((key, line, desc),)
+                    memo[key] = chain
+                    return chain, True
+            sub_stack = stack | {key}
+            exhaustive = True
+            for callee, line in self.calls_of(key):
+                cinfo = self.funcs[callee]
+                if hop_ok is not None and not hop_ok(cinfo):
+                    continue
+                if ctx.line_suppressed(rule_set, line):
+                    continue
+                sub, sub_exhaustive = dfs(callee, sub_stack)
+                if sub is not None:
+                    chain = ((key, line, None),) + sub
+                    memo[key] = chain
+                    return chain, True
+                exhaustive = exhaustive and sub_exhaustive
+            if exhaustive:
+                memo[key] = None
+            return None, exhaustive
+
+        return dfs(start, frozenset())[0]
+
+    def render_chain(self, chain: tuple) -> str:
+        """Human-readable call chain: `a (f.py:3) -> b (g.py:7) ->
+        time.sleep [g.py:9]` — the last element is the primitive."""
+        hops = []
+        for key, line, desc in chain:
+            rel, _, qual = key.partition("::")
+            if desc is None:
+                hops.append(f"{qual} ({rel}:{line})")
+            else:
+                hops.append(f"{qual} ({rel}:{line}) -> {desc}")
+        return " -> ".join(hops)
+
+
 # -- runner -------------------------------------------------------------
 
 
@@ -392,6 +800,47 @@ def iter_py_files(paths: list[str], repo: str = REPO) -> Iterator[str]:
     yield from sorted(out)
 
 
+def _parse_context(source: str, rel: str) -> FileContext | Finding:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return Finding(
+            "syntax-error",
+            rel,
+            e.lineno or 1,
+            (e.offset or 0) + 1,
+            f"cannot parse: {e.msg}",
+        )
+    return FileContext(rel, source, tree)
+
+
+def _check_file(
+    ctx: FileContext,
+    rules: Iterable[Rule],
+    allowlist: Allowlist,
+    *,
+    report_pragma_errors: bool,
+    known_rules: Iterable[str] | None,
+) -> list[Finding]:
+    profile = profile_for(ctx.rel)
+    findings: list[Finding] = []
+    for rule in rules:
+        if not rule.applies_to(ctx.rel, profile):
+            continue
+        if allowlist.exempt(rule.id, ctx.rel):
+            continue
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                findings.append(f)
+    if report_pragma_errors:
+        findings.extend(
+            ctx.pragma_errors(
+                frozenset(known_rules) if known_rules is not None else None
+            )
+        )
+    return findings
+
+
 def lint_source(
     source: str,
     rel: str,
@@ -399,39 +848,89 @@ def lint_source(
     allowlist: Allowlist | None = None,
     *,
     report_pragma_errors: bool = True,
+    known_rules: Iterable[str] | None = None,
 ) -> list[Finding]:
     """Lint one in-memory source blob as if it lived at `rel`.
 
-    This is the seam the fixture tests drive: rules see exactly what
-    they would see on a real file, including profile selection, scope
-    matching, pragma suppression and allowlist exemption.
+    This is the seam the per-file fixture tests drive: rules see
+    exactly what they would see on a real file, including profile
+    selection, scope matching, pragma suppression and allowlist
+    exemption. Project rules are skipped (one blob has no project —
+    drive those through `lint_tree`).
     """
     allowlist = allowlist or Allowlist()
-    profile = profile_for(rel)
-    try:
-        tree = ast.parse(source)
-    except SyntaxError as e:
-        return [
-            Finding(
-                "syntax-error",
-                rel,
-                e.lineno or 1,
-                (e.offset or 0) + 1,
-                f"cannot parse: {e.msg}",
-            )
-        ]
-    ctx = FileContext(rel, source, tree)
-    findings: list[Finding] = []
+    ctx = _parse_context(source, rel)
+    if isinstance(ctx, Finding):
+        return [ctx]
+    findings = _check_file(
+        ctx,
+        list(rules),
+        allowlist,
+        report_pragma_errors=report_pragma_errors,
+        known_rules=known_rules,
+    )
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def _run_project_rules(
+    pctx: ProjectContext,
+    rules: Iterable[Rule],
+    allowlist: Allowlist,
+) -> list[Finding]:
+    """Run every ProjectRule over the built context; per-finding
+    suppression/exemption is applied against the finding's OWN file
+    (pragma on the reported line, allowlist by path prefix). No path
+    restriction: a project finding is reported wherever it lands."""
+    out: list[Finding] = []
+    pctx.allowlist = allowlist
     for rule in rules:
-        if not rule.applies_to(rel, profile):
+        if not isinstance(rule, ProjectRule):
             continue
-        if allowlist.exempt(rule.id, rel):
+        for f in rule.check_project(pctx):
+            if allowlist.exempt(f.rule, f.path):
+                continue
+            fctx = pctx.files.get(f.path)
+            if fctx is not None and fctx.suppressed(f):
+                continue
+            out.append(f)
+    return out
+
+
+def lint_tree(
+    sources: dict[str, str],
+    rules: Iterable[Rule],
+    allowlist: Allowlist | None = None,
+    *,
+    full_tree: bool = True,
+    report_pragma_errors: bool = False,
+    known_rules: Iterable[str] | None = None,
+) -> list[Finding]:
+    """Lint an in-memory {rel: source} tree — per-file AND project
+    rules. This is the fixture seam for the interprocedural and
+    wire-schema analyzers: a test hands over a handful of synthetic
+    files and sees exactly what a real scan of that tree would."""
+    allowlist = allowlist or Allowlist()
+    rules = list(rules)
+    findings: list[Finding] = []
+    files: dict[str, FileContext] = {}
+    for rel, source in sources.items():
+        ctx = _parse_context(source, rel)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
             continue
-        for f in rule.check(ctx):
-            if not ctx.suppressed(f):
-                findings.append(f)
-    if report_pragma_errors:
-        findings.extend(ctx.pragma_errors())
+        files[rel] = ctx
+        findings.extend(
+            _check_file(
+                ctx,
+                rules,
+                allowlist,
+                report_pragma_errors=report_pragma_errors,
+                known_rules=known_rules,
+            )
+        )
+    pctx = ProjectContext(files, full_tree=full_tree)
+    findings.extend(_run_project_rules(pctx, rules, allowlist))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
@@ -443,22 +942,60 @@ def lint_paths(
     repo: str = REPO,
     *,
     report_pragma_errors: bool = True,
+    known_rules: Iterable[str] | None = None,
+    restrict_to: Iterable[str] | None = None,
 ) -> tuple[list[Finding], int]:
-    """Lint files/dirs; returns (findings, files_scanned)."""
+    """Lint files/dirs; returns (findings, files_scanned).
+
+    Project rules see the WHOLE scanned surface as one ProjectContext
+    (`full_tree` when the package root itself is in the scan roots).
+    `restrict_to` (repo-relative paths) filters PER-FILE findings to
+    those files without shrinking the analysis surface; project-rule
+    findings are always reported wherever they land — a transitive
+    chain or a wire-schema diff caused by your edit may surface in a
+    file you did not touch (including the lockfile), and the gate keeps
+    the tree clean, so under --changed any project finding IS a
+    consequence of the change in hand.
+    """
+    allowlist = allowlist or Allowlist()
     rules = list(rules)
+    restrict = (
+        {p.replace(os.sep, "/") for p in restrict_to}
+        if restrict_to is not None
+        else None
+    )
     findings: list[Finding] = []
+    files: dict[str, FileContext] = {}
     n = 0
     for rel in iter_py_files(paths, repo):
         n += 1
         with open(os.path.join(repo, rel), encoding="utf-8") as f:
             source = f.read()
+        ctx = _parse_context(source, rel)
+        if isinstance(ctx, Finding):
+            if restrict is None or rel in restrict:
+                findings.append(ctx)
+            continue
+        files[rel] = ctx
+        if restrict is not None and rel not in restrict:
+            continue
         findings.extend(
-            lint_source(
-                source,
-                rel,
+            _check_file(
+                ctx,
                 rules,
                 allowlist,
                 report_pragma_errors=report_pragma_errors,
+                known_rules=known_rules,
             )
         )
+    roots = {
+        os.path.relpath(
+            p if os.path.isabs(p) else os.path.join(repo, p), repo
+        ).replace(os.sep, "/").rstrip("/")
+        for p in paths
+    }
+    full = bool(roots & {".", "tendermint_tpu"})
+    pctx = ProjectContext(files, full_tree=full)
+    findings.extend(_run_project_rules(pctx, rules, allowlist))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, n

@@ -11,7 +11,9 @@ from . import (
     clock_rules,
     containment_rules,
     nondeterminism_rules,
+    project_rules,
     trace_rules,
+    wire_rules,
 )
 
 ALL_RULES = (
@@ -20,7 +22,9 @@ ALL_RULES = (
     *clock_rules.RULES,
     *containment_rules.RULES,
     *nondeterminism_rules.RULES,
+    *project_rules.RULES,
     *trace_rules.RULES,
+    *wire_rules.RULES,
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,396 @@
+"""Interprocedural analyzers — the per-file disciplines propagated over
+the tree-wide call graph.
+
+The per-file rules see one frame: `time.sleep` lexically inside an
+`async def` is a finding, the same sleep one call away in a sync helper
+is invisible — and PRs 11-13 showed that is exactly where the real
+regressions hide (a reactor coroutine calling a "cheap" helper that
+grew a blocking read three refactors later). These rules walk the
+`ProjectContext` call graph instead: a coroutine calling a sync chain
+that reaches a blocking primitive / a raw verify / a raw storage write
+N hops away is a finding AT THE COROUTINE, with the whole chain in the
+message.
+
+Resolution is conservative by construction (see
+`ProjectContext.resolve_call_target`): an edge the import tables cannot
+pin to exactly one in-tree function does not exist, so a missed edge
+costs recall, never a false finding. Suppression composes with the
+chain: a reasoned pragma on ANY hop (the coroutine's call, an
+intermediate edge, or the primitive itself) breaks the chain — one
+audited annotation at the right boundary covers every caller above it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from ..framework import (
+    FileContext,
+    Finding,
+    FuncInfo,
+    ProjectContext,
+    ProjectRule,
+    _same_frame_nodes,
+    method_name,
+    profile_for,
+)
+from .async_rules import BlockingInAsync
+from .chokepoint_rules import FsDiscipline, VerifyChokepoint
+
+
+def _sync_calls(info: FuncInfo) -> Iterator[ast.Call]:
+    for node in _same_frame_nodes(info.node):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+class TransitiveBlocking(ProjectRule):
+    id = "transitive-blocking"
+    doc = (
+        "no coroutine may reach a blocking primitive (time.sleep, raw "
+        "open(), subprocess, sqlite3) through a SYNC call chain — the "
+        "interprocedural half of blocking-in-async: the helper's "
+        "helper's sleep still parks this coroutine's event loop"
+    )
+    profiles = ("node",)
+
+    #: pragma ids that break a chain at any hop: the project rule's own
+    #: id, or the per-file id on the primitive line (one annotation
+    #: serves both analyzers)
+    CHAIN_IDS = ("transitive-blocking", "blocking-in-async")
+
+    def _hits(self, pctx: ProjectContext):
+        blocking = BlockingInAsync.BLOCKING_CALLS
+        prefixes = BlockingInAsync.BLOCKING_PREFIXES
+
+        def hits(info: FuncInfo) -> list[tuple[int, str]]:
+            if info.is_async:
+                return []
+            ctx = pctx.files[info.rel]
+            out = []
+            for node in _sync_calls(info):
+                name = ctx.resolve_call(node)
+                if name in blocking or (name and name.startswith(prefixes)):
+                    out.append((node.lineno, f"{name}(...)"))
+            return out
+
+        return hits
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        hits = self._hits(pctx)
+        memo: dict = {}
+
+        def hop_ok(info: FuncInfo) -> bool:
+            return not info.is_async
+
+        for key, info in pctx.funcs.items():
+            if not info.is_async or profile_for(info.rel) != "node":
+                continue
+            ctx = pctx.files[info.rel]
+            for callee, line in pctx.calls_of(key):
+                cinfo = pctx.funcs[callee]
+                if not hop_ok(cinfo) or ctx.line_suppressed(self.CHAIN_IDS, line):
+                    continue
+                chain = pctx.find_witness(
+                    callee,
+                    hits,
+                    rule_ids=self.CHAIN_IDS,
+                    hop_ok=hop_ok,
+                    memo=memo,
+                )
+                if chain is None:
+                    continue
+                primitive = chain[-1][2]
+                yield Finding(
+                    self.id,
+                    info.rel,
+                    line,
+                    1,
+                    f"coroutine `{info.qualname}` reaches blocking "
+                    f"`{primitive}` through a sync call chain "
+                    f"({len(chain)} hop(s)): {pctx.render_chain(chain)} — "
+                    "the helper's sleep parks THIS event loop (the "
+                    "statesync-backfill saturation class, now visible "
+                    "across files); make the chain async or cross it "
+                    "via asyncio.to_thread",
+                    ctx.line_text(line),
+                )
+
+
+class TransitiveVerify(ProjectRule):
+    id = "transitive-verify"
+    doc = (
+        "no coroutine in the async scopes (consensus/blocksync/statesync/"
+        "mempool/rpc/light) may reach a raw verify (verify_signature, the "
+        "hub's sync facade) through a sync helper chain — the helper is "
+        "legal standing alone (sync contexts may block), the call FROM a "
+        "coroutine is the defect the per-file rule cannot see"
+    )
+    profiles = ("node",)
+
+    CHAIN_IDS = ("transitive-verify", "verify-chokepoint")
+
+    def _hits(self, pctx: ProjectContext):
+        def hits(info: FuncInfo) -> list[tuple[int, str]]:
+            if info.is_async:
+                return []
+            if pctx.allowlist.exempt("verify-chokepoint", info.rel):
+                return []  # crypto/ and friends ARE the chokepoint
+            ctx = pctx.files[info.rel]
+            out = []
+            for node in _sync_calls(info):
+                m = method_name(node)
+                if m == "verify_signature":
+                    out.append((node.lineno, "*.verify_signature(...)"))
+                elif m == "verify_sync":
+                    out.append((node.lineno, "hub.verify_sync(...)"))
+                elif m == "result" and VerifyChokepoint._submit_receiver(node):
+                    out.append(
+                        (node.lineno, "submit_nowait(...).result(...)")
+                    )
+            return out
+
+        return hits
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        hits = self._hits(pctx)
+        memo: dict = {}
+
+        def hop_ok(info: FuncInfo) -> bool:
+            return not info.is_async and not pctx.allowlist.exempt(
+                "verify-chokepoint", info.rel
+            )
+
+        for key, info in pctx.funcs.items():
+            if not info.is_async:
+                continue
+            if not any(
+                info.rel.startswith(p) for p in VerifyChokepoint.ASYNC_SCOPES
+            ):
+                continue
+            ctx = pctx.files[info.rel]
+            for callee, line in pctx.calls_of(key):
+                cinfo = pctx.funcs[callee]
+                if not hop_ok(cinfo) or ctx.line_suppressed(self.CHAIN_IDS, line):
+                    continue
+                chain = pctx.find_witness(
+                    callee,
+                    hits,
+                    rule_ids=self.CHAIN_IDS,
+                    hop_ok=hop_ok,
+                    memo=memo,
+                )
+                if chain is None:
+                    continue
+                primitive = chain[-1][2]
+                yield Finding(
+                    self.id,
+                    info.rel,
+                    line,
+                    1,
+                    f"coroutine `{info.qualname}` reaches {primitive} "
+                    f"through a sync chain: {pctx.render_chain(chain)} — "
+                    "per-signature blocking verify on the event loop, "
+                    "batch occupancy pinned at 1; await the hub "
+                    "(hub.verify / averify_one) at this boundary instead",
+                    ctx.line_text(line),
+                )
+
+
+class TransitiveFs(ProjectRule):
+    id = "transitive-fs"
+    doc = (
+        "storage-layer code (WAL/store/state) must not reach raw file "
+        "mutations by calling OUT of its scope — a helper in libs/ doing "
+        "`open(path, 'wb')` on the WAL's behalf escapes chaos-fs fault "
+        "injection exactly like an inline raw write would"
+    )
+    profiles = ("node",)
+
+    CHAIN_IDS = ("transitive-fs", "fs-discipline")
+
+    def _hits(self, pctx: ProjectContext):
+        scope = FsDiscipline.scope
+
+        def hits(info: FuncInfo) -> list[tuple[int, str]]:
+            # inside the fs scope the PER-FILE rule owns raw writes;
+            # hits here are the escapes it cannot see
+            if any(info.rel.startswith(p) for p in scope):
+                return []
+            if pctx.allowlist.exempt("fs-discipline", info.rel):
+                return []
+            ctx = pctx.files[info.rel]
+            out = []
+            for node in _sync_calls(info):
+                name = ctx.resolve_call(node)
+                if name in FsDiscipline.OS_MUTATIONS:
+                    out.append((node.lineno, f"{name}(...)"))
+                elif name == "open" and FsDiscipline._binary_write_mode(node):
+                    out.append((node.lineno, "open(..., 'wb/ab')"))
+            return out
+
+        return hits
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        hits = self._hits(pctx)
+        memo: dict = {}
+
+        def hop_ok(info: FuncInfo) -> bool:
+            return not pctx.allowlist.exempt("fs-discipline", info.rel)
+
+        for key, info in pctx.funcs.items():
+            if not any(info.rel.startswith(p) for p in FsDiscipline.scope):
+                continue
+            if pctx.allowlist.exempt("fs-discipline", info.rel):
+                continue
+            if profile_for(info.rel) != "node":
+                continue
+            ctx = pctx.files[info.rel]
+            for callee, line in pctx.calls_of(key):
+                cinfo = pctx.funcs[callee]
+                if not hop_ok(cinfo) or ctx.line_suppressed(self.CHAIN_IDS, line):
+                    continue
+                chain = pctx.find_witness(
+                    callee,
+                    hits,
+                    rule_ids=self.CHAIN_IDS,
+                    hop_ok=hop_ok,
+                    memo=memo,
+                )
+                if chain is None:
+                    continue
+                primitive = chain[-1][2]
+                yield Finding(
+                    self.id,
+                    info.rel,
+                    line,
+                    1,
+                    f"storage path `{info.qualname}` reaches raw "
+                    f"{primitive} outside the chaos-fs layer: "
+                    f"{pctx.render_chain(chain)} — the crash-recovery "
+                    "matrix cannot inject faults it cannot see; thread "
+                    "the injected libs/chaosfs.FS through the helper",
+                    ctx.line_text(line),
+                )
+
+
+def _in_cleanup(ctx: FileContext, node: ast.AST) -> bool:
+    """True when `node` sits in a finally: block or an
+    except-CancelledError handler of its enclosing function (the
+    contexts where a second cancel can be absorbed mid-cleanup)."""
+    child = node
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return False
+        if isinstance(anc, ast.Try) and any(
+            child is s or child in ast.walk(s) for s in anc.finalbody
+        ):
+            return True
+        if isinstance(anc, ast.ExceptHandler):
+            t = anc.type
+            elts = t.elts if isinstance(t, ast.Tuple) else [t] if t else []
+            for e in elts:
+                n = e.id if isinstance(e, ast.Name) else (
+                    e.attr if isinstance(e, ast.Attribute) else None
+                )
+                if n == "CancelledError":
+                    return True
+        child = anc
+    return False
+
+
+def _unshielded_wait_fors(
+    ctx: FileContext, info: FuncInfo
+) -> list[tuple[int, str]]:
+    out = []
+    for node in _same_frame_nodes(info.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if ctx.resolve_call(node) not in ("asyncio.wait_for", "wait_for"):
+            continue
+        if _in_cleanup(ctx, node):
+            continue  # the per-file rule already owns that case
+        if node.args:
+            waited = node.args[0]
+            if isinstance(waited, ast.Call) and ctx.resolve_call(waited) in (
+                "asyncio.shield",
+                "shield",
+            ):
+                continue
+        out.append((node.lineno, "asyncio.wait_for(...)"))
+    return out
+
+
+class TransitiveCleanup(ProjectRule):
+    id = "transitive-cleanup"
+    doc = (
+        "an await in a cleanup path (finally / except CancelledError) "
+        "must not reach an un-shielded asyncio.wait_for through helper "
+        "coroutines — pre-3.11 wait_for can absorb the second cancel "
+        "mid-cleanup wherever it runs, not just where it is written"
+    )
+    profiles = ("node",)
+
+    CHAIN_IDS = ("transitive-cleanup", "absorbed-cancellation")
+
+    def check_project(self, pctx: ProjectContext) -> Iterable[Finding]:
+        memo: dict = {}
+
+        def hits(info: FuncInfo) -> list[tuple[int, str]]:
+            if not info.is_async:
+                return []
+            return _unshielded_wait_fors(pctx.files[info.rel], info)
+
+        def hop_ok(info: FuncInfo) -> bool:
+            return info.is_async
+
+        for key, info in pctx.funcs.items():
+            if not info.is_async or profile_for(info.rel) != "node":
+                continue
+            ctx = pctx.files[info.rel]
+            for node in _same_frame_nodes(info.node):
+                if not isinstance(node, ast.Await) or not isinstance(
+                    node.value, ast.Call
+                ):
+                    continue
+                if not _in_cleanup(ctx, node):
+                    continue
+                callee = pctx.resolve_call_target(info, node.value)
+                if callee is None:
+                    continue
+                cinfo = pctx.funcs[callee]
+                if not cinfo.is_async:
+                    continue
+                chain = pctx.find_witness(
+                    callee,
+                    hits,
+                    rule_ids=self.CHAIN_IDS,
+                    hop_ok=hop_ok,
+                    memo=memo,
+                )
+                if chain is None:
+                    continue
+                line = node.value.lineno
+                if ctx.line_suppressed(self.CHAIN_IDS, line):
+                    continue
+                yield Finding(
+                    self.id,
+                    info.rel,
+                    line,
+                    1,
+                    f"cleanup-path await in `{info.qualname}` reaches an "
+                    f"un-shielded wait_for: {pctx.render_chain(chain)} — "
+                    "a second cancel arriving here can be absorbed "
+                    "mid-cleanup (py3.10); shield the waited work at the "
+                    "helper or hoist the wait_for out of the cancel path",
+                    ctx.line_text(line),
+                )
+
+
+RULES = (
+    TransitiveBlocking(),
+    TransitiveVerify(),
+    TransitiveFs(),
+    TransitiveCleanup(),
+)

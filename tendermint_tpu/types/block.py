@@ -21,6 +21,16 @@ from .keys import (
     SignedMsgType,
 )
 
+# Wire-side sanity bounds. Blocks and commits arrive from untrusted
+# peers (block-sync, catch-up gossip, light provider responses) and the
+# chaos matrix corrupts frames that still parse — a flipped repeat
+# count must raise at decode, never allocate (tmtlint wire-bounds).
+# Validator sets are ≤ a few hundred in practice; 2^16 signatures and
+# 2^20 txs/evidence items are malformed by construction.
+MAX_WIRE_COMMIT_SIGS = 1 << 16
+MAX_WIRE_BLOCK_TXS = 1 << 20
+MAX_WIRE_BLOCK_EVIDENCE = 1 << 16
+
 
 @dataclass(frozen=True)
 class PartSetHeader:
@@ -274,6 +284,10 @@ class Commit:
                 block_id = BlockID.decode(r.read_bytes())
             elif f == 4:
                 sigs.append(CommitSig.decode(r.read_bytes()))
+                if len(sigs) > MAX_WIRE_COMMIT_SIGS:
+                    raise ValueError(
+                        f"commit signatures exceed {MAX_WIRE_COMMIT_SIGS}"
+                    )
             elif f == 5:
                 agg_sig = r.read_bytes()
             else:
@@ -503,10 +517,16 @@ class Block:
                 header = Header.decode(r.read_bytes())
             elif f == 2:
                 txs.append(r.read_bytes())
+                if len(txs) > MAX_WIRE_BLOCK_TXS:
+                    raise ValueError(f"block txs exceed {MAX_WIRE_BLOCK_TXS}")
             elif f == 3:
                 last_commit = Commit.decode(r.read_bytes())
             elif f == 4:
                 evidence.append(decode_evidence(r.read_bytes()))
+                if len(evidence) > MAX_WIRE_BLOCK_EVIDENCE:
+                    raise ValueError(
+                        f"block evidence exceeds {MAX_WIRE_BLOCK_EVIDENCE}"
+                    )
             else:
                 r.skip(wt)
         return cls(header, tuple(txs), tuple(evidence), last_commit)

@@ -15,7 +15,7 @@ from dataclasses import dataclass
 
 from ..crypto.hashes import sha256
 from ..libs import protoenc as pe
-from .validator_set import Validator, ValidatorSet
+from .validator_set import MAX_WIRE_VALIDATORS, Validator, ValidatorSet
 from .vote import Vote
 
 EVIDENCE_DUPLICATE_VOTE = 1
@@ -233,6 +233,10 @@ class LightClientAttackEvidence:
                 ch = r.read_uvarint()
             elif f == 4:
                 byz.append(Validator.decode(r.read_bytes()))
+                if len(byz) > MAX_WIRE_VALIDATORS:
+                    raise ValueError(
+                        f"LCA byzantine validators exceed {MAX_WIRE_VALIDATORS}"
+                    )
             elif f == 5:
                 tvp = r.read_uvarint()
             elif f == 6:

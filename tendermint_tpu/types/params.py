@@ -9,6 +9,11 @@ from dataclasses import dataclass, field, replace
 from ..crypto.hashes import sha256
 from ..libs import protoenc as pe
 
+# Wire-side sanity bound: params ride untrusted statesync frames — a
+# corrupt repeat count must raise, never allocate (tmtlint wire-bounds).
+# The key-type registry has single digits of schemes.
+MAX_PUB_KEY_TYPES = 64
+
 
 @dataclass(frozen=True)
 class BlockParams:
@@ -90,6 +95,10 @@ class ConsensusParams:
                     ff, wwt = rr.read_tag()
                     if ff == 1:
                         types.append(rr.read_bytes().decode())
+                        if len(types) > MAX_PUB_KEY_TYPES:
+                            raise ValueError(
+                                f"pub_key_types exceed {MAX_PUB_KEY_TYPES}"
+                            )
                     else:
                         rr.skip(wwt)
                 val = ValidatorParams(tuple(types))

@@ -17,6 +17,12 @@ from ..crypto.merkle import hash_from_byte_slices
 from ..libs import protoenc as pe
 from .keys import MAX_TOTAL_VOTING_POWER, PRIORITY_WINDOW_SIZE_FACTOR
 
+# Wire-side sanity bound: validator sets ride untrusted frames (light
+# blocks, statesync params, evidence) — a corrupt repeat count must
+# raise at decode, never allocate (tmtlint wire-bounds). Real
+# committees are ≤ a few hundred validators.
+MAX_WIRE_VALIDATORS = 1 << 16
+
 
 def _div_trunc(a: int, b: int) -> int:
     q = abs(a) // abs(b)
@@ -252,6 +258,10 @@ class ValidatorSet:
             f, wt = r.read_tag()
             if f == 1:
                 vals.append(Validator.decode(r.read_bytes()))
+                if len(vals) > MAX_WIRE_VALIDATORS:
+                    raise ValueError(
+                        f"validator set exceeds {MAX_WIRE_VALIDATORS} entries"
+                    )
             elif f == 2:
                 proposer_addr = r.read_bytes()
             else:

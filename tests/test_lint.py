@@ -1055,13 +1055,58 @@ def test_pragma_inside_string_literal_is_not_a_pragma():
     assert {f.rule for f in run_all(src)} == {"blocking-in-async"}
 
 
+def test_pragma_with_unknown_rule_id_is_reported():
+    """A typo'd rule id used to suppress nothing and report nothing —
+    the worst failure mode for an auditable-suppression scheme. With the
+    registry handed to the run, the typo is itself a finding."""
+    src = textwrap.dedent(
+        """
+        import time
+        async def worker():
+            time.sleep(1.0)  # tmtlint: allow[blocking-in-asink] -- typo'd id
+        """
+    )
+    fs = lint_source(src, NODE_PATH, ALL_RULES, known_rules=set(RULES_BY_ID))
+    rules = {f.rule for f in fs}
+    # the typo'd pragma does not suppress, AND the typo is reported
+    assert rules == {"blocking-in-async", BAD_PRAGMA}
+    bad = [f for f in fs if f.rule == BAD_PRAGMA]
+    assert any("unknown rule id" in f.message and "blocking-in-asink" in f.message
+               for f in bad)
+
+
+def test_pragma_with_known_ids_wildcard_and_badpragma_never_flagged_unknown():
+    src = textwrap.dedent(
+        """
+        import time
+        async def worker():
+            time.sleep(1.0)  # tmtlint: allow[*] -- fixture
+        """
+    )
+    assert lint_source(src, NODE_PATH, ALL_RULES, known_rules=set(RULES_BY_ID)) == []
+    # without a registry (single-rule fixture runs) unknown ids are not
+    # this run's business — same gating as bad-pragma vs --rule
+    src2 = textwrap.dedent(
+        """
+        import time
+        async def worker():
+            time.sleep(1.0)  # tmtlint: allow[no-such-rule] -- still reported missing nothing
+        """
+    )
+    fs = lint_source(src2, NODE_PATH, ALL_RULES)  # known_rules=None
+    assert {f.rule for f in fs} == {"blocking-in-async"}
+
+
 # ---------------------------------------------------------------------------
 # driver + whole-tree gate (tier-1)
 
 
 def _lint(*args: str) -> subprocess.CompletedProcess:
+    """Run the REAL entrypoint (`scripts/tmtlint`) — the tier-1 gate,
+    CI and pre-commit all go through this one file, so the gate test
+    must too (one code path, no second driver to drift)."""
     return subprocess.run(
-        [sys.executable, os.path.join(REPO, "scripts", "lint.py"), *args],
+        [sys.executable, os.path.join(REPO, "scripts", "tmtlint"), *args],
         cwd=REPO,
         capture_output=True,
         text=True,
@@ -1071,16 +1116,48 @@ def _lint(*args: str) -> subprocess.CompletedProcess:
 
 def test_repo_tree_is_clean_and_fast():
     """THE gate: the repo's own code holds every invariant the analyzers
-    enforce, and the full run fits the tier-1 time budget (suite is
-    ~815s of 870s — this must stay a rounding error)."""
+    enforce — including the interprocedural and wire-schema passes —
+    and the full run fits the tier-1 time budget (suite is ~815s of
+    870s — this must stay a rounding error)."""
     out = _lint("--json")
     assert out.returncode == 0, out.stdout + out.stderr
     payload = json.loads(out.stdout)
     assert payload["clean"] is True
     assert payload["files_scanned"] > 100  # actually walked the tree
-    assert len(payload["rules"]) >= 6
+    assert len(payload["rules"]) >= 15
     # bench guard: wall time is recorded in the JSON and bounded
     assert payload["elapsed_s"] < 10.0, f"lint too slow: {payload['elapsed_s']}s"
+    # per-rule finding counts ride the JSON (zeros included) so BENCH
+    # rounds can diff lint drift across PRs
+    assert set(payload["per_rule"]) == set(payload["rules"])
+    assert all(v == 0 for v in payload["per_rule"].values())
+    for required in ("transitive-blocking", "wire-schema", "wire-bounds"):
+        assert required in payload["per_rule"]
+
+
+def test_legacy_lint_py_alias_same_code_path():
+    """scripts/lint.py predates the tmtlint CLI; it must stay a pure
+    alias (same main(), same output shape)."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+         "--json", "--rule", "task-leak", "tendermint_tpu/libs"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["rules"] == ["task-leak"] and "per_rule" in payload
+
+
+def test_retired_regex_shims_route_through_tmtlint():
+    """check_fs_callsites / check_verify_callsites predate the PR 4
+    framework; they are now aliases over the tmtlint rules (per-file +
+    transitive) and must exit clean on the tree."""
+    for shim in ("check_fs_callsites.py", "check_verify_callsites.py"):
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", shim)],
+            cwd=REPO, capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, (shim, out.stdout, out.stderr)
 
 
 def test_driver_rule_filter_and_errors():
