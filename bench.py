@@ -1455,6 +1455,189 @@ def bench_light_fleet(
     return out
 
 
+def bench_statesync_fleet(
+    n_blocks: int = 64,
+    n_vals: int = 21,
+    n_joiners: int = 8,
+    ab_vals: int = 64,
+    ab_heights: int = 32,
+    timeout_s: float = 420.0,
+) -> dict:
+    """statesync config: the BootFleet mass-onboarding workload — two
+    bounded phases, both structured-outcome (the chaos_soak discipline):
+
+      join_wave    — N concurrent cold joiners statesync against ONE
+                     donor's BootD over the real reactor protocol:
+                     joiners/s, chunks/s, time-to-synced p50/p99, the
+                     donor-overhead story (app store reads per joiner +
+                     the shared-chunk-cache amortization factor), shed
+                     count at the session bound.
+      backfill_ab  — the hub backfill-lane verification A/B on the same
+                     window shape: per-sig ed25519 commits mega-batched
+                     through verify_commit_range vs a BLS committee's
+                     aggregate commits (ONE pairing per height via
+                     verify_hub.verify_aggregate). Verification memos
+                     cleared first, so both are cold-verify rates.
+
+    CPU-image scale-down via TMTPU_BENCH_SS_* (pure-python BLS signing
+    dominates A/B chain construction there; the amortization and wire
+    numbers are backend-independent)."""
+    import asyncio
+    import tempfile
+
+    from tendermint_tpu import testing
+    from tendermint_tpu.libs.watchdog import LoopWatchdog
+    from tendermint_tpu.statesync.fleet import verify_backfill_batch
+
+    out: dict = {
+        "n_blocks": n_blocks,
+        "n_vals": n_vals,
+        "n_joiners": n_joiners,
+        "join_wave": {},
+        "backfill_ab": {"n_vals": ab_vals, "n_heights": ab_heights},
+    }
+
+    # -- phase 1: the join wave -----------------------------------------
+    t0 = time.perf_counter()
+    try:
+        wd = LoopWatchdog(
+            tempfile.mkdtemp(prefix="statesync-wd-"), threshold_s=30.0
+        )
+
+        async def wave() -> dict:
+            wd.start()
+            try:
+                return await asyncio.wait_for(
+                    testing.statesync_fleet_scenario(
+                        n_blocks, n_vals, n_joiners
+                    ),
+                    timeout_s,
+                )
+            finally:
+                wd.stop()
+
+        res = asyncio.run(wave())
+        lat = sorted(res["time_to_synced_s"])
+
+        def pct(p: float) -> float:
+            if not lat:
+                return 0.0
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        st = res["server_stats"]
+        elapsed = max(res["elapsed_s"], 1e-9)
+        rec = {
+            "outcome": "ok" if res["joined"] == n_joiners else "partial",
+            "joined": res["joined"],
+            "join_errors": res["join_errors"][:4],
+            "joiners_per_s": round(res["joined"] / elapsed, 2),
+            "chunks_per_s": round(st["chunks_served"] / elapsed, 1),
+            "p50_time_to_synced_s": round(pct(0.50), 4),
+            "p99_time_to_synced_s": round(pct(0.99), 4),
+            "sheds": st["sheds"],
+            "cache_hit_rate": round(
+                st["cache_hits"]
+                / max(st["cache_hits"] + st["cache_misses"], 1),
+                4,
+            ),
+            # donor overhead: what serving the whole wave actually cost
+            # the donor's app — reads amortized by the shared cache
+            "donor_store_reads": st["store_reads"],
+            "donor_store_reads_per_joiner": round(
+                st["store_reads"] / max(res["joined"], 1), 3
+            ),
+            "chunk_amortization_factor": round(
+                st["chunks_served"] / max(st["store_reads"], 1), 2
+            ),
+            "backfill_sigs": res["joiner_backfill"]["backfill_sigs"],
+            "backfill_sigs_per_s": round(
+                res["joiner_backfill"]["backfill_sigs"] / elapsed, 1
+            ),
+            "backfill_batches": res["joiner_backfill"]["backfill_batches"],
+        }
+    except Exception as e:  # noqa: BLE001 — structured outcome
+        rec = {"outcome": f"error: {e!r}"[:200]}
+    rec["wall_s"] = round(time.perf_counter() - t0, 2)
+    out["join_wave"] = rec
+    log(
+        f"statesync[join_wave]: {rec.get('outcome')} "
+        f"{rec.get('joiners_per_s', 0)} joiners/s "
+        f"{rec.get('chunks_per_s', 0)} chunks/s "
+        f"p99={rec.get('p99_time_to_synced_s', 0)}s "
+        f"amortization={rec.get('chunk_amortization_factor', 0)}x"
+    )
+
+    # -- phase 2: backfill verification A/B -----------------------------
+    from tendermint_tpu.crypto import bls_math
+    from tendermint_tpu.crypto import ed25519 as _ed
+    from tendermint_tpu.light.types import LightBlock, SignedHeader
+    from tendermint_tpu.types.block import aggregate_commit
+
+    chain_id = "ssab-chain"
+    for scheme, key_types, agg in (
+        ("per_sig", ("ed25519",), False),
+        ("bls_aggregate", ("bls12381",), True),
+    ):
+        t0 = time.perf_counter()
+        try:
+            log(f"statesync: building {ab_vals}-val {scheme} backfill window …")
+            vals, by_addr = testing.make_validator_set(
+                ab_vals, key_types=key_types, seed=b"ssab-" + scheme.encode()
+            )
+            window = testing.make_light_chain(
+                ab_heights, vals, by_addr, chain_id
+            )
+            if agg:
+                window = [
+                    LightBlock(
+                        SignedHeader(
+                            lb.signed_header.header,
+                            aggregate_commit(lb.signed_header.commit, vals),
+                        ),
+                        vals,
+                    )
+                    for lb in window
+                ]
+            wire = len(window[0].signed_header.commit.encode())
+            bls_math._H2_MEMO.clear()
+            _ed._VERIFY_MEMO.clear()
+
+            async def bounded(_w=window):
+                return await asyncio.wait_for(
+                    verify_backfill_batch(chain_id, _w), timeout_s
+                )
+
+            v0 = time.perf_counter()
+            n_sigs = asyncio.run(bounded())
+            dt = max(time.perf_counter() - v0, 1e-9)
+            rec = {
+                "outcome": "ok",
+                "commit_wire_bytes": wire,
+                "heights_per_s": round(ab_heights / dt, 1),
+                "verify_sigs": n_sigs,
+                # signatures COVERED per second: an aggregate commit
+                # covers the committee with one pairing
+                "sigs_covered_per_s": round(ab_heights * ab_vals / dt, 1),
+            }
+        except Exception as e:  # noqa: BLE001 — structured outcome
+            rec = {"outcome": f"error: {e!r}"[:200]}
+        rec["wall_s"] = round(time.perf_counter() - t0, 2)
+        out["backfill_ab"][scheme] = rec
+        log(
+            f"statesync[backfill:{scheme}]: {rec.get('outcome')} "
+            f"{rec.get('heights_per_s', 0)} heights/s "
+            f"{rec.get('sigs_covered_per_s', 0)} sigs-covered/s "
+            f"wire={rec.get('commit_wire_bytes', 0)}B"
+        )
+    per = out["backfill_ab"].get("per_sig", {})
+    agg_rec = out["backfill_ab"].get("bls_aggregate", {})
+    if per.get("outcome") == "ok" and agg_rec.get("outcome") == "ok":
+        out["backfill_ab"]["wire_ratio"] = round(
+            per["commit_wire_bytes"] / agg_rec["commit_wire_bytes"], 2
+        )
+    return out
+
+
 def _multichip_measure(n_sigs: int, reps: int = 2) -> dict:
     """multichip config, in-process half: sharded vs single-device
     verification of the same batch on whatever mesh this process sees.
@@ -2092,6 +2275,50 @@ def main() -> None:
             )
         except Exception as e:  # noqa: BLE001
             log(f"light-fleet bench failed: {e!r}")
+    # statesync runs on BOTH backends, BOUNDED: the BootFleet
+    # mass-onboarding workload — N cold joiners vs one donor's BootD
+    # (joiners/s, chunks/s, time-to-synced p50/p99, donor store-read
+    # amortization) plus the hub backfill-lane per-sig vs bls-aggregate
+    # verification A/B. On CPU images the committee and wave scale down
+    # (pure-python BLS dominates A/B chain construction); amortization
+    # and wire numbers are backend-independent.
+    if os.environ.get("TMTPU_BENCH_STATESYNC") != "0":
+        try:
+            ss_blocks = int(
+                os.environ.get(
+                    "TMTPU_BENCH_SS_BLOCKS",
+                    "64" if backend != "cpu" else "48",
+                )
+            )
+            ss_vals = int(
+                os.environ.get(
+                    "TMTPU_BENCH_SS_VALS",
+                    "21" if backend != "cpu" else "7",
+                )
+            )
+            ss_joiners = int(
+                os.environ.get(
+                    "TMTPU_BENCH_SS_JOINERS",
+                    "8" if backend != "cpu" else "4",
+                )
+            )
+            ss_ab_vals = int(
+                os.environ.get(
+                    "TMTPU_BENCH_SS_AB_VALS",
+                    "64" if backend != "cpu" else "16",
+                )
+            )
+            ss_ab_heights = int(
+                os.environ.get(
+                    "TMTPU_BENCH_SS_AB_HEIGHTS",
+                    "32" if backend != "cpu" else "8",
+                )
+            )
+            extra["statesync"] = bench_statesync_fleet(
+                ss_blocks, ss_vals, ss_joiners, ss_ab_vals, ss_ab_heights
+            )
+        except Exception as e:  # noqa: BLE001
+            log(f"statesync bench failed: {e!r}")
     # verifyd runs on BOTH backends, BOUNDED: N worker processes flood
     # one sidecar daemon vs N in-process backends — aggregate sigs/s,
     # attach counts (the one-warm-mesh amortization headline), p99

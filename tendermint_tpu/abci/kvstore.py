@@ -43,9 +43,16 @@ def _state_hash(items: dict[bytes, bytes]) -> bytes:
 
 
 class KVStoreApp(BaseApplication):
-    def __init__(self, db: DB | None = None, *, retain_blocks: int = 0):
+    def __init__(
+        self,
+        db: DB | None = None,
+        *,
+        retain_blocks: int = 0,
+        snapshot_interval: int = 10,
+    ):
         self.db = db or MemDB()
         self.retain_blocks = retain_blocks
+        self.snapshot_interval = max(1, snapshot_interval)
         self.items: dict[bytes, bytes] = {}
         self.height = 0
         self.app_hash = b""
@@ -220,7 +227,7 @@ class KVStoreApp(BaseApplication):
     # -- snapshots --------------------------------------------------------
 
     def _take_snapshot(self) -> None:
-        if self.height % 10 != 0:  # snapshot cadence, e2e app style
+        if self.height % self.snapshot_interval != 0:  # snapshot cadence
             return
         blob = json.dumps(
             {

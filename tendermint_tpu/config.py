@@ -243,6 +243,35 @@ class LightDConfig:
 
 
 @dataclass
+class BootDConfig:
+    """BootD — the mass snapshot-serving layer (statesync/fleet.py):
+    bounded concurrent chunk sessions + a shared per-snapshot chunk
+    cache in front of the app's snapshot store, plus the manifest loop
+    that commits/prunes served snapshots on a height interval off the
+    consensus hot path. Env mirrors win over TOML (the VerifyHub
+    contract): TMTPU_BOOTD_SESSIONS / TMTPU_BOOTD_CHUNK_CACHE /
+    TMTPU_BOOTD_REFRESH_S."""
+
+    #: concurrent chunk-loading sessions before arrivals are rejected
+    #: with busy (BootDBusyError — shed is backpressure, not failure;
+    #: cache hits and coalesced same-chunk joins never shed)
+    max_sessions: int = 32
+    #: chunk bytes kept in the shared cache (entries, insertion-evicted):
+    #: N concurrent joiners amortize each store read to ONE
+    chunk_cache: int = 256
+    #: manifest refresh cadence (seconds): how often the serving
+    #: manifest re-reads ListSnapshots and prunes dead chunk bytes
+    refresh_s: float = 2.0
+    #: serve only snapshots whose height is a multiple of this interval
+    #: (1 = every snapshot the app took); pruned entries leave the
+    #: manifest AND the chunk cache on the next refresh
+    snapshot_interval: int = 1
+    #: backfilled commits verified per hub batch (the backfill lane
+    #: mega-batching window)
+    backfill_batch: int = 64
+
+
+@dataclass
 class TraceConfig:
     """Flight-recorder tracing (libs/trace.py): structured spans over
     the verify funnel landing in a bounded per-process ring buffer,
@@ -291,6 +320,7 @@ class Config:
     chaos_fs: ChaosFSConfig = field(default_factory=ChaosFSConfig)
     verify_hub: VerifyHubConfig = field(default_factory=VerifyHubConfig)
     lightd: LightDConfig = field(default_factory=LightDConfig)
+    bootd: BootDConfig = field(default_factory=BootDConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
 
 
@@ -337,6 +367,8 @@ def config_to_toml(cfg: Config) -> str:
         "",
         _section_to_toml("lightd", cfg.lightd),
         "",
+        _section_to_toml("bootd", cfg.bootd),
+        "",
         _section_to_toml("trace", cfg.trace),
         "",
     ]
@@ -364,6 +396,7 @@ def config_from_toml(text: str) -> Config:
         ("chaos_fs", cfg.chaos_fs),
         ("verify_hub", cfg.verify_hub),
         ("lightd", cfg.lightd),
+        ("bootd", cfg.bootd),
         ("trace", cfg.trace),
     ):
         _apply_section(obj, data.get(section, {}))

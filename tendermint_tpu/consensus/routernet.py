@@ -42,6 +42,14 @@ from ..evidence import EVIDENCE_CHANNEL
 from ..evidence.reactor import EvidenceReactor
 from ..p2p.memory import MemoryNetwork
 from ..p2p.testing import RouterShell
+from ..statesync import (
+    CHUNK_CHANNEL,
+    LIGHT_BLOCK_CHANNEL,
+    PARAMS_CHANNEL,
+    SNAPSHOT_CHANNEL,
+)
+from ..statesync import messages as ss_msgs
+from ..statesync.reactor import StateSyncReactor, SyncConfig
 from ..types.evidence import decode_evidence
 from . import messages as m
 from .harness import MS, Node, fast_config, make_genesis
@@ -178,6 +186,27 @@ class RouterNode:
         )
         self.reactor: ConsensusReactor | None = None
         self.ev_reactor: EvidenceReactor | None = None
+        # statesync serving (BootFleet): channels + reactor exist only
+        # when the net opts in — a plain consensus soak carries zero
+        # extra tasks
+        self.ss_reactor: StateSyncReactor | None = None
+        if net.statesync:
+            for cid, name in (
+                (SNAPSHOT_CHANNEL, "ss-snapshot"),
+                (CHUNK_CHANNEL, "ss-chunk"),
+                (LIGHT_BLOCK_CHANNEL, "ss-lb"),
+                (PARAMS_CHANNEL, "ss-params"),
+            ):
+                setattr(
+                    self,
+                    name.replace("-", "_") + "_ch",
+                    r.open_channel(
+                        cid, name=name, priority=3,
+                        encode=ss_msgs.encode_message,
+                        decode=ss_msgs.decode_message,
+                        queue_size=qs,
+                    ),
+                )
 
     # convenience mirrors of the inner harness node
     @property
@@ -216,9 +245,25 @@ class RouterNode:
             self.ev_ch,
             self.shell.peer_manager.subscribe(),
         )
+        if self.net.statesync:
+            self.ss_reactor = StateSyncReactor(
+                self.net.genesis.chain_id,
+                self.inner.app_conns,
+                self.inner.state_store,
+                self.inner.block_store,
+                self.ss_snapshot_ch,
+                self.ss_chunk_ch,
+                self.ss_lb_ch,
+                self.ss_params_ch,
+                self.shell.peer_manager.subscribe(),
+                initial_height=self.net.genesis.initial_height,
+                bootd_config=self.net.bootd_config,
+            )
         await self.shell.router.start()
         await self.reactor.start()
         await self.ev_reactor.start()
+        if self.ss_reactor is not None:
+            await self.ss_reactor.start()
         if self.net.prepare_hook is not None:
             self.net.prepare_hook(self)
 
@@ -230,12 +275,26 @@ class RouterNode:
         await self.go()
 
     async def stop(self) -> None:
+        if self.ss_reactor is not None:
+            await self.ss_reactor.stop()
         if self.ev_reactor is not None:
             await self.ev_reactor.stop()
         if self.reactor is not None:
             await self.reactor.stop()
         await self.inner.stop()
         await self.shell.router.stop()
+
+    async def statesync_join(self, sync_config: SyncConfig) -> None:
+        """Cold-join the running committee: statesync a snapshot (chunks
+        from donors' BootDs, backfill sigs batched onto the hub backfill
+        lane), point the consensus SM at the restored state, then start
+        it — the reactor's own catch-up gossip closes the snapshot->tip
+        gap, exactly like a restarted node."""
+        if self.ss_reactor is None:
+            raise RuntimeError("statesync_join requires RouterNet(statesync=True)")
+        state = await self.ss_reactor.sync(sync_config)
+        self.inner.cs.update_to_state(state)
+        await self.go()
 
 
 class RouterNet:
@@ -271,6 +330,11 @@ class RouterNet:
         # lag-storm must not let laggards eat the donors' loop share)
         catchup_rate: float | None = None,
         catchup_burst: int | None = None,
+        # BootFleet: every node opens the statesync channels and runs a
+        # StateSyncReactor (serving through its BootD); joiners built via
+        # make_joiner() use the same reactor to cold-join the committee
+        statesync: bool = False,
+        bootd_config=None,
     ):
         self.genesis, self.keys = make_genesis(n_vals, key_type=key_type)
         self.config = config or fast_config()
@@ -294,6 +358,9 @@ class RouterNet:
         # recoverable (stall-refresh) but costs seconds each time
         self.queue_size = 1024 if self.n <= 16 else 16384
         self.use_hub = use_hub
+        self.statesync = statesync
+        self.bootd_config = bootd_config
+        self._joiners = 0
         self._hub = None
         self._fs_factory = fs_factory
         self._app_factory = app_factory
@@ -311,6 +378,10 @@ class RouterNet:
         self.nodes: list[RouterNode] = [
             self._build_node(i) for i in range(self.n)
         ]
+        # cold nodes built by make_joiner(): stopped with the net but
+        # deliberately NOT in self.nodes — heights()/wait_for_height
+        # measure the committee, and a joiner mid-statesync has no height
+        self.joiners: list[RouterNode] = []
 
     # -- construction ----------------------------------------------------
 
@@ -347,6 +418,26 @@ class RouterNet:
             wal_dir=wal_dir,
         )
 
+    def make_joiner(self, *, app=None, donors: int = 3) -> RouterNode:
+        """Build a cold full node (no validator key, empty stores) wired
+        to `donors` committee members' addresses. Caller drives the join:
+        `await j.prepare(); await j.statesync_join(cfg)`. Requires
+        statesync=True (the joiner needs donors serving snapshots)."""
+        if not self.statesync:
+            raise RuntimeError("make_joiner requires RouterNet(statesync=True)")
+        idx = self.n + self._joiners
+        self._joiners += 1
+        if app is None and self._app_factory is not None:
+            app = self._app_factory(idx)
+        node = RouterNode(self, idx, None, app=app)
+        self.joiners.append(node)
+        # deterministic donor choice: spread joiners across the
+        # committee so N joiners don't all dogpile node 0
+        for k in range(min(donors, self.n)):
+            donor = self.nodes[(idx + k) % self.n]
+            node.shell.peer_manager.add_address(donor.shell.address())
+        return node
+
     # -- lifecycle -------------------------------------------------------
 
     async def start(self) -> None:
@@ -370,7 +461,8 @@ class RouterNet:
 
     async def stop(self) -> None:
         results = await asyncio.gather(
-            *(node.stop() for node in self.nodes), return_exceptions=True
+            *(node.stop() for node in self.nodes + self.joiners),
+            return_exceptions=True,
         )
         for r in results:
             if isinstance(r, Exception):

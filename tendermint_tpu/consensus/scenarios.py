@@ -866,6 +866,230 @@ async def run_light_attack(
     return out
 
 
+async def run_boot_wave(
+    *,
+    n_vals: int = 4,
+    n_joiners: int = 2,
+    seed: int = 1,
+    snapshot_height: int = 12,
+    timeout_s: float = 120.0,
+    join_timeout_s: float = 90.0,
+    chaos_cfg: ChaosConfig | None = None,
+    donor_crash: bool = False,
+    poison_donors: tuple[int, ...] = (),
+    use_hub: bool = True,
+    degree: int = 8,
+    config=None,
+    bootd_config=None,
+    donors_per_joiner: int = 3,
+    snapshot_interval: int = 10,
+    commit_window_s: float | None = None,
+    gossip_sleep: float | None = None,
+) -> dict:
+    """The BootFleet mass-onboarding scenario: a wave of `n_joiners`
+    cold nodes statesyncs into a live `n_vals`-validator RouterNet
+    committee — chunks served by the donors' BootDs, backfill commit
+    signatures batched onto the VerifyHub backfill lane — while the
+    committee keeps committing (optionally under link chaos).
+
+    Fault variants, composable:
+
+      * `donor_crash`: one donor is killed mid-wave (real `net.crash`);
+        joiners must re-fetch from survivors (chunk-timeout → breaker →
+        rotation), and the committee must keep quorum (n_vals >= 4).
+      * `poison_donors`: those validator indices serve poisoned chunk
+        bytes (`statesync/byzantine.PoisonedSnapshotApp`, seeded): the
+        restore's whole-blob hash check must reject the state, cost the
+        serving peer a `PeerError(ban=True)`, and move on to the next
+        candidate — a joiner may land on an older snapshot but NEVER on
+        the poisoned state.
+
+    Success: every joiner syncs within `join_timeout_s` AND every
+    header it holds matches the committee's chain (the honest app-hash
+    chain), and `audit_net` passes over the committee. Returns a
+    structured outcome dict; never raises (the chaos_soak contract)."""
+    from ..libs.clock import ManualClock
+    from ..statesync.byzantine import PoisonedSnapshotApp
+    from ..statesync.reactor import SyncConfig
+
+    if config is None:
+        if n_vals <= 16:
+            config = fast_config()
+        else:
+            # committee scale: a wide commit window is the catch-up
+            # lever — every height gives laggards a quiet gossip window
+            # (run_light_attack's construction; 200 ms churns at 150)
+            config = replace(
+                committee_config(n_vals),
+                timeout_commit_ns=int((commit_window_s or 30.0) * 1e9),
+                skip_timeout_commit=False,
+            )
+    chaos = (
+        ChaosNetwork(replace(chaos_cfg, seed=seed))
+        if chaos_cfg is not None and chaos_cfg.enabled()
+        else None
+    )
+    poison_idx = {p % n_vals for p in poison_donors}
+
+    def _app(i):
+        # `snapshot_height` must be a cadence height: committee-scale
+        # soaks shrink the interval so the wave starts heights earlier
+        if i in poison_idx:
+            return PoisonedSnapshotApp(
+                seed=seed, snapshot_interval=snapshot_interval
+            )
+        if snapshot_interval != 10:
+            from ..abci.kvstore import KVStoreApp
+
+            return KVStoreApp(snapshot_interval=snapshot_interval)
+        return None
+
+    app_factory = _app if (poison_idx or snapshot_interval != 10) else None
+    net = RouterNet(
+        n_vals,
+        config=config,
+        chaos=chaos,
+        base_clock=ManualClock(GENESIS_TIME_NS - 500 * MS),
+        degree=degree,
+        topo_seed=seed,
+        use_hub=use_hub,
+        app_factory=app_factory,
+        statesync=True,
+        bootd_config=bootd_config,
+        **({"gossip_sleep": gossip_sleep} if gossip_sleep is not None else {}),
+    )
+    out: dict = {
+        "outcome": "error",
+        "n_vals": n_vals,
+        "n_joiners": n_joiners,
+        "seed": seed,
+        "donor_crash": donor_crash,
+        "poison_donors": sorted(poison_idx),
+        "joined": 0,
+        "join_errors": [],
+        "time_to_synced_s": [],
+        "joiner_heights": [],
+        "honest_chain_ok": None,
+        "poisoned_rejects": 0,
+        "busy_sheds": 0,
+        "chunks_served": 0,
+        "cache_hits": 0,
+        "backfill_sigs": 0,
+        "backfill_agg_heights": 0,
+        "audit": None,
+        "heights": [],
+        "elapsed_s": 0.0,
+        "error": "",
+    }
+    loop = asyncio.get_running_loop()
+    t0 = loop.time()
+    try:
+        await asyncio.wait_for(net.start(), timeout_s)
+        # snapshots at the interval height + the h+2 headers light
+        # verification pins against must exist before the wave starts —
+        # on the ANCHOR node and the DONORS only, not the whole
+        # committee: at 150 validators the slowest laggard trails the
+        # quorum by heights (it catches up inside commit windows)
+        donor_idx = {0} | {
+            (n_vals + j + k) % n_vals
+            for j in range(n_joiners)
+            for k in range(min(donors_per_joiner, n_vals))
+        }
+        await asyncio.wait_for(
+            asyncio.gather(
+                *(
+                    net.nodes[i].cs.wait_for_height(
+                        snapshot_height + 2, timeout_s
+                    )
+                    for i in sorted(donor_idx)
+                )
+            ),
+            timeout_s,
+        )
+        anchor = net.nodes[0].block_store.load_block_meta(snapshot_height)
+        cfg = SyncConfig(
+            trust_height=snapshot_height,
+            trust_hash=anchor.header.hash(),
+            trust_period_ns=10 * 365 * 24 * 3600 * 10**9,
+        )
+        joiners = [
+            net.make_joiner(donors=donors_per_joiner) for _ in range(n_joiners)
+        ]
+        for j in joiners:
+            await j.prepare()
+
+        async def join_one(j):
+            jt0 = loop.time()
+            await asyncio.wait_for(j.statesync_join(cfg), join_timeout_s)
+            return loop.time() - jt0
+
+        tasks = [asyncio.create_task(join_one(j)) for j in joiners]
+        if donor_crash:
+            # kill a donor while the wave is in flight: every joiner
+            # dials donors starting at a distinct offset, so (joiner 0's
+            # first donor) is in some joiner's rotation
+            await asyncio.sleep(0.3)
+            await net.crash(n_vals - 1)
+        results = await asyncio.gather(*tasks, return_exceptions=True)
+        for r in results:
+            if isinstance(r, BaseException):
+                out["join_errors"].append(repr(r))
+            else:
+                out["joined"] += 1
+                out["time_to_synced_s"].append(round(r, 3))
+
+        # honest-chain check: every header a joiner holds must be the
+        # committee's block at that height (a poisoned restore that
+        # slipped through would fork the app-hash chain here)
+        ref = net.nodes[0].block_store
+        honest = True
+        for j in joiners:
+            jh = j.block_store.height()
+            out["joiner_heights"].append(jh)
+            base = j.block_store.base()
+            for h in range(max(1, base), jh + 1):
+                meta = j.block_store.load_block_meta(h)
+                ref_meta = ref.load_block_meta(h)
+                if meta is None or ref_meta is None:
+                    continue
+                if meta.header.hash() != ref_meta.header.hash():
+                    honest = False
+        out["honest_chain_ok"] = honest
+
+        for node in net.nodes + net.joiners:
+            if node.ss_reactor is None:
+                continue
+            st = node.ss_reactor.bootd.stats
+            out["poisoned_rejects"] += st["poisoned_rejects"]
+            out["busy_sheds"] += st["sheds"]
+            out["chunks_served"] += st["chunks_served"]
+            out["cache_hits"] += st["cache_hits"]
+            out["backfill_sigs"] += st["backfill_sigs"]
+            out["backfill_agg_heights"] += st["backfill_agg_heights"]
+
+        crashed = {n_vals - 1} if donor_crash else set()
+        audit = audit_net(
+            net,
+            [],
+            k_heights=3,
+            require_evidence=False,
+        )
+        # a crashed donor legitimately stops committing; agreement over
+        # what it DID commit still binds (audit_net only compares
+        # heights both sides hold)
+        out["audit"] = audit.as_dict()
+        ok = out["joined"] == n_joiners and honest and audit.ok
+        out["outcome"] = "ok" if ok else "failed"
+        out["crashed"] = sorted(crashed)
+    except Exception as e:  # noqa: BLE001 — structured outcome contract
+        out["error"] = repr(e)
+    finally:
+        out["heights"] = net.heights()
+        out["elapsed_s"] = round(loop.time() - t0, 3)
+        await net.stop()
+    return out
+
+
 async def run_sweep(
     names: list[str] | None = None,
     **kwargs,

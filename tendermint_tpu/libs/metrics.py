@@ -346,6 +346,68 @@ class NodeMetrics:
             "request-to-verified-verdict latency per sync",
             buckets=SYNC_BUCKETS,
         )
+        # BootD — the statesync snapshot-serving layer (statesync/
+        # fleet.py; live instances registered process-wide, folded at
+        # render time like the lightd family)
+        from ..statesync.fleet import BOOT_BUCKETS
+
+        self.bootd_chunk_requests = r.counter(
+            "bootd", "chunk_requests", "chunk requests received (incl. shed)"
+        )
+        self.bootd_chunks_served = r.counter(
+            "bootd", "chunks_served", "chunk payloads served"
+        )
+        self.bootd_chunk_bytes = r.counter(
+            "bootd", "chunk_bytes", "chunk payload bytes served"
+        )
+        self.bootd_sheds = r.counter(
+            "bootd", "sheds",
+            "chunk requests shed-with-busy at the session bound (backpressure)",
+        )
+        self.bootd_coalesced = r.counter(
+            "bootd", "coalesced", "chunk requests joined onto an in-flight load"
+        )
+        self.bootd_cache_hits = r.counter(
+            "bootd", "cache_hits", "chunks served from the shared snapshot cache"
+        )
+        self.bootd_store_reads = r.counter(
+            "bootd", "store_reads",
+            "app store reads (cache misses that actually hit the app)",
+        )
+        self.bootd_snapshots_served = r.counter(
+            "bootd", "snapshots_served", "snapshot manifests served to joiners"
+        )
+        self.bootd_backfill_heights = r.counter(
+            "bootd", "backfill_heights",
+            "backfilled heights whose commits passed hub verification",
+        )
+        self.bootd_backfill_sigs = r.counter(
+            "bootd", "backfill_sigs",
+            "per-signature commit verifications batched onto the backfill lane",
+        )
+        self.bootd_backfill_scheme = r.counter(
+            "bootd", "backfill_by_scheme",
+            "backfilled heights per commit scheme (bls-aggregate vs per-sig)",
+        )
+        self.bootd_poisoned_rejects = r.counter(
+            "bootd", "poisoned_rejects",
+            "snapshot restores rejected for poisoned bytes (peer punished)",
+        )
+        self.bootd_synced = r.counter(
+            "bootd", "synced", "state syncs completed by this node"
+        )
+        self.bootd_sessions = r.gauge(
+            "bootd", "sessions", "chunk-serving sessions in flight right now"
+        )
+        self.bootd_cache_hit_rate = r.gauge(
+            "bootd", "cache_hit_rate", "hits / (hits + misses)"
+        )
+        self.bootd_time_to_synced = r.histogram(
+            "bootd",
+            "time_to_synced_seconds",
+            "discovery-to-restored-state latency per completed sync",
+            buckets=BOOT_BUCKETS,
+        )
         # event fan-out (libs/pubsub.py drop_on_full subscriptions —
         # the websocket path; folded from pubsub.DROPPED at render)
         self.pubsub_dropped_events = r.counter(
@@ -771,6 +833,42 @@ class NodeMetrics:
             dst._sum = sum_
             dst._count = count
 
+    def _fold_bootd(self) -> None:
+        from ..statesync import fleet
+
+        s, hist = fleet.aggregate()
+        if s is None:
+            return
+        self.bootd_chunk_requests._values[()] = s["chunk_requests"]
+        self.bootd_chunks_served._values[()] = s["chunks_served"]
+        self.bootd_chunk_bytes._values[()] = s["chunk_bytes"]
+        self.bootd_sheds._values[()] = s["sheds"]
+        self.bootd_coalesced._values[()] = s["coalesced"]
+        self.bootd_cache_hits._values[()] = s["cache_hits"]
+        self.bootd_store_reads._values[()] = s["store_reads"]
+        self.bootd_snapshots_served._values[()] = s["snapshots_served"]
+        self.bootd_backfill_heights._values[()] = s["backfill_heights"]
+        self.bootd_backfill_sigs._values[()] = s["backfill_sigs"]
+        self.bootd_backfill_scheme._values[(("scheme", "bls-aggregate"),)] = s[
+            "backfill_agg_heights"
+        ]
+        self.bootd_backfill_scheme._values[(("scheme", "per-sig"),)] = (
+            s["backfill_heights"] - s["backfill_agg_heights"]
+        )
+        self.bootd_poisoned_rejects._values[()] = s["poisoned_rejects"]
+        self.bootd_synced._values[()] = s["synced"]
+        self.bootd_sessions.set(s["sessions_now"])
+        lookups = s["cache_hits"] + s["cache_misses"]
+        self.bootd_cache_hit_rate.set(
+            round(s["cache_hits"] / lookups, 4) if lookups else 0.0
+        )
+        counts, sum_, count = hist
+        dst = self.bootd_time_to_synced
+        if len(counts) == len(dst._counts):  # same BOOT_BUCKETS layout
+            dst._counts = counts
+            dst._sum = sum_
+            dst._count = count
+
     def _fold_steps(self) -> None:
         from ..consensus.state import aggregate_step_metrics
 
@@ -838,6 +936,7 @@ class NodeMetrics:
         self._fold_ingest()
         self._fold_mempool()
         self._fold_lightd()
+        self._fold_bootd()
         self._fold_steps()
         self._fold_backend()
         self._fold_bls()

@@ -173,6 +173,13 @@ class LightClient:
         latest = self.store.latest()
         if latest is None:
             latest = await self.initialize()
+            # the anchor initialize() just pinned may BE the requested
+            # height (statesync joiners trust the snapshot height
+            # itself) — verifying it "forward" against itself would
+            # raise "untrusted height <= trusted"
+            existing = self.store.get(height) if height else None
+            if existing is not None:
+                return existing
         target = await self.primary.light_block(height)
         # Strategies BUFFER newly verified blocks instead of persisting:
         # nothing primary-supplied may reach the trusted store until the

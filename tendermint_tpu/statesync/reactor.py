@@ -1,19 +1,30 @@
 """Statesync reactor (reference internal/statesync/reactor.go:142).
 
-Serving side: answers snapshot discovery from the app's ListSnapshots,
-chunk requests from LoadSnapshotChunk, light-block requests from the
-local stores, and params requests from the state store.
+Serving side: answers snapshot discovery from BootD's manifest
+(statesync/fleet.py — committed/pruned off the consensus hot path),
+chunk requests through BootD's bounded sessions + shared chunk cache
+(a shed becomes ``ChunkResponse(busy=True)`` — backpressure the joiner
+retries, never a failure), light-block requests (single and batched)
+from the local stores, and params requests from the state store.
 
 Syncing side (`sync()`, reference Sync :269 + syncer.go):
-  1. discover snapshots from peers (0x60)
+  1. discover snapshots from peers (0x60); candidates are keyed by
+     CONTENT (height, format, hash, chunks), so a Byzantine donor's
+     poisoned offer is a distinct candidate that fails alone instead
+     of shadowing the honest snapshot at the same height
   2. verify the target height's header via the light client over the
      p2p light-block channel (0x62) — the state provider
   3. offer the snapshot to the app; fetch chunks in parallel (0x61);
-     ApplySnapshotChunk until accepted
+     ApplySnapshotChunk until accepted. A rejected restore costs every
+     provider that served bytes a `PeerError` (score hit) and the
+     joiner moves to the next candidate — poison never wedges a join
   4. verify the app's restored hash against the verified header
-  5. bootstrap State + block store, then Backfill recent headers
-     (hash-chain linked, reference reactor.go:348,481) so evidence
-     verification has history
+  5. bootstrap State + block store, then Backfill recent headers:
+     fetched in batched windows (0x62 batch frames), hash-chain linked
+     (reference reactor.go:348,481) AND signature-verified through the
+     VerifyHub backfill lane — one mega-batched funnel call per
+     window, one aggregate pairing per height for BLS committees
+     (statesync/fleet.verify_backfill_batch)
 """
 
 from __future__ import annotations
@@ -24,6 +35,7 @@ from dataclasses import dataclass
 from fractions import Fraction
 
 from ..abci import types as abci
+from ..libs import trace
 from ..libs.retry import BackoffPolicy, CircuitBreaker
 from ..libs.service import Service
 from ..light.client import LightClient, TrustOptions, TrustedStore
@@ -34,12 +46,23 @@ from ..p2p.router import Channel
 from ..p2p.types import Envelope, PeerError
 from ..state.state import State
 from ..types.block import BlockID
+from ..types.validation import InvalidCommitError
 from . import CHUNK_CHANNEL, LIGHT_BLOCK_CHANNEL, PARAMS_CHANNEL, SNAPSHOT_CHANNEL
 from . import messages as m
+from .fleet import BootD, BootDBusyError, verify_backfill_batch
 
 DISCOVERY_TIME = 2.0
 CHUNK_TIMEOUT = 10.0
 CHUNK_FETCHERS = 4
+# discovered-snapshot candidates kept (decode-bound discipline at the
+# ingest point: discovery is broadcast-fed, so the dict must be bounded
+# even though each frame is individually clamped)
+MAX_DISCOVERED_SNAPSHOTS = 32
+# restore attempts per discovered snapshot before it is abandoned: a
+# poisoned serve costs the PEER (ban + provider-set removal), so the
+# retry runs against the survivors — bounded so a restore that fails
+# for a non-attributable reason cannot loop forever
+MAX_SNAPSHOT_ATTEMPTS = 3
 # inter-attempt backoff for peer fetches (light blocks, chunks, params):
 # full jitter keeps a burst of failed fetchers from re-hammering the same
 # peer in lockstep
@@ -139,6 +162,8 @@ class StateSyncReactor(Service):
         peer_updates: asyncio.Queue,
         *,
         initial_height: int = 1,
+        bootd: BootD | None = None,
+        bootd_config=None,
         logger: logging.Logger | None = None,
     ):
         super().__init__("ss-reactor", logger)
@@ -154,9 +179,19 @@ class StateSyncReactor(Service):
         self.peer_updates = peer_updates
         self.peers: list[str] = []
         self.dispatcher = _Dispatcher(self)
-        # discovery results: (height, format) -> (snapshot, set(providers))
-        self._snapshots: dict[tuple[int, int], tuple[m.SnapshotsResponse, set[str]]] = {}
+        # the serving layer: bounded chunk sessions + shared chunk cache
+        # + the manifest commit/prune loop (statesync/fleet.py). Owned
+        # unless the caller shares one across reactors.
+        self.bootd = bootd or BootD(app_conns, config=bootd_config)
+        self._owns_bootd = bootd is None
+        # discovery results, keyed by snapshot CONTENT so a poisoned
+        # offer at an honest height stays a separate candidate:
+        # (height, format, hash, chunks) -> (snapshot, set(providers))
+        self._snapshots: dict[
+            tuple[int, int, bytes, int], tuple[m.SnapshotsResponse, set[str]]
+        ] = {}
         self._chunk_futures: dict[tuple[int, int, int], asyncio.Future] = {}
+        self._batch_futures: dict[int, asyncio.Future] = {}
         self._params_futures: dict[int, asyncio.Future] = {}
         # per-provider chunk-serving health: a peer that repeatedly times
         # out is skipped (fail fast) until its breaker half-opens
@@ -171,11 +206,17 @@ class StateSyncReactor(Service):
         return br
 
     async def on_start(self) -> None:
+        if self._owns_bootd:
+            await self.bootd.start()
         self.spawn(self._process_peer_updates(), name="ssr.peers")
         self.spawn(self._process_snapshot_ch(), name="ssr.snap")
         self.spawn(self._process_chunk_ch(), name="ssr.chunk")
         self.spawn(self._process_lb_ch(), name="ssr.lb")
         self.spawn(self._process_params_ch(), name="ssr.params")
+
+    async def on_stop(self) -> None:
+        if self._owns_bootd:
+            await self.bootd.stop()
 
     def _send(self, ch: Channel, msg, *, to: str = "", broadcast: bool = False) -> None:
         try:
@@ -199,8 +240,8 @@ class StateSyncReactor(Service):
         async for env in self.snapshot_ch:
             msg = env.message
             if isinstance(msg, m.SnapshotsRequest):
-                res = await self.app_conns.snapshot.list_snapshots()
-                for snap in res.snapshots[-4:]:
+                snapshots = await self.bootd.serve_snapshots()
+                for snap in snapshots[-4:]:
                     self._send(
                         self.snapshot_ch,
                         m.SnapshotsResponse(
@@ -209,7 +250,12 @@ class StateSyncReactor(Service):
                         to=env.from_,
                     )
             elif isinstance(msg, m.SnapshotsResponse):
-                key = (msg.height, msg.format)
+                key = (msg.height, msg.format, msg.hash, msg.chunks)
+                if (
+                    key not in self._snapshots
+                    and len(self._snapshots) >= MAX_DISCOVERED_SNAPSHOTS
+                ):
+                    continue  # bounded discovery set; newcomers wait
                 snap, providers = self._snapshots.get(key, (msg, set()))
                 providers.add(env.from_)
                 self._snapshots[key] = (snap, providers)
@@ -218,13 +264,26 @@ class StateSyncReactor(Service):
         async for env in self.chunk_ch:
             msg = env.message
             if isinstance(msg, m.ChunkRequest):
-                res = await self.app_conns.snapshot.load_snapshot_chunk(
-                    abci.RequestLoadSnapshotChunk(msg.height, msg.format, msg.index)
-                )
+                try:
+                    chunk = await self.bootd.serve_chunk(
+                        msg.height, msg.format, msg.index
+                    )
+                except BootDBusyError:
+                    # shed is backpressure, not failure: the joiner
+                    # retries this donor after backoff instead of
+                    # marking the chunk missing here
+                    self._send(
+                        self.chunk_ch,
+                        m.ChunkResponse(
+                            msg.height, msg.format, msg.index, busy=True
+                        ),
+                        to=env.from_,
+                    )
+                    continue
                 self._send(
                     self.chunk_ch,
                     m.ChunkResponse(
-                        msg.height, msg.format, msg.index, res.chunk, not res.chunk
+                        msg.height, msg.format, msg.index, chunk, not chunk
                     ),
                     to=env.from_,
                 )
@@ -241,6 +300,34 @@ class StateSyncReactor(Service):
                 self._send(self.lb_ch, m.LightBlockResponse(lb), to=env.from_)
             elif isinstance(msg, m.LightBlockResponse):
                 self.dispatcher.deliver(msg.light_block)
+            elif isinstance(msg, m.LightBlockBatchRequest):
+                # serve the window [from_height-count+1, from_height]
+                # newest first, stopping at the first height we lack —
+                # the joiner needs a hash-linked PREFIX, and a gap would
+                # just break its chain check anyway
+                lbs: list[LightBlock] = []
+                count = min(msg.count, m.MAX_WIRE_BACKFILL_BATCH)
+                for h in range(msg.from_height, msg.from_height - count, -1):
+                    if h < 1:
+                        break
+                    lb = self._local_light_block(h)
+                    if lb is None:
+                        break
+                    lbs.append(lb)
+                self._send(
+                    self.lb_ch,
+                    m.LightBlockBatchResponse(tuple(lbs)),
+                    to=env.from_,
+                )
+            elif isinstance(msg, m.LightBlockBatchResponse):
+                top = msg.light_blocks[0].height if msg.light_blocks else None
+                fut = (
+                    self._batch_futures.get(top)
+                    if top is not None
+                    else next(iter(self._batch_futures.values()), None)
+                )
+                if fut is not None and not fut.done():
+                    fut.set_result(msg.light_blocks)
 
     def _local_light_block(self, height: int) -> LightBlock | None:
         if height == 0:
@@ -267,10 +354,40 @@ class StateSyncReactor(Service):
                 if fut is not None and not fut.done():
                     fut.set_result(msg.params)
 
+    async def _punish_providers(self, peers, reason: str) -> None:
+        """Score-hit + quarantine every named peer (poisoned bytes are
+        Byzantine, not flaky: `ban=True` escalates the dial cooldown).
+        Punished peers are also dropped from every discovered snapshot's
+        provider set, so the retry of a candidate (reference syncer
+        bans-and-refetches the SAME snapshot) can only use peers that
+        have not already served us garbage."""
+        self.bootd.stats["poisoned_rejects"] += 1
+        punished = set(peers)
+        for _snap, provs in self._snapshots.values():
+            provs -= punished
+        for peer in punished:
+            self.logger.warning("penalizing peer %s: %s", peer[:12], reason)
+            await self.chunk_ch.error(PeerError(peer, reason, ban=True))
+
     # -- sync side -------------------------------------------------------
 
     async def sync(self, config: SyncConfig) -> State:
-        """Reference Sync reactor.go:269 + SyncAny syncer.go:167."""
+        """Reference Sync reactor.go:269 + SyncAny syncer.go:167.
+        Wrapped in a `boot.sync` flight-recorder span; a completed join
+        lands in BootD's time-to-synced histogram."""
+        t0 = asyncio.get_running_loop().time()
+        with trace.span("boot", "sync", trust_height=config.trust_height) as sp:
+            try:
+                state = await self._sync(config)
+            except BaseException as e:
+                sp.set(outcome=type(e).__name__)
+                raise
+            elapsed = asyncio.get_running_loop().time() - t0
+            self.bootd.record_synced(elapsed)
+            sp.set(outcome="synced", height=state.last_block_height)
+            return state
+
+    async def _sync(self, config: SyncConfig) -> State:
         light = LightClient(
             self.chain_id,
             TrustOptions(config.trust_period_ns, config.trust_height, config.trust_hash),
@@ -285,17 +402,24 @@ class StateSyncReactor(Service):
             self._send(self.snapshot_ch, m.SnapshotsRequest(), broadcast=True)
             await asyncio.sleep(DISCOVERY_TIME)
 
-        tried: set[tuple[int, int]] = set()
+        # a candidate stays retryable while it has attempts left AND
+        # unpunished providers: a poisoned donor costs itself, not the
+        # snapshot (reference syncer bans the sender and refetches)
+        attempts: dict[tuple, int] = {}
         while True:
             candidates = sorted(
-                (k for k in self._snapshots if k not in tried),
+                (
+                    k
+                    for k, (_s, provs) in self._snapshots.items()
+                    if attempts.get(k, 0) < MAX_SNAPSHOT_ATTEMPTS and provs
+                ),
                 key=lambda k: (-k[0], k[1]),
             )
             if not candidates:
                 raise SyncAbortedError("all discovered snapshots failed")
             key = candidates[0]
             snap, providers = self._snapshots[key]
-            tried.add(key)
+            attempts[key] = attempts.get(key, 0) + 1
             try:
                 return await self._restore(snap, list(providers), light, config)
             except SyncAbortedError:
@@ -331,6 +455,9 @@ class StateSyncReactor(Service):
 
         # fetch + apply chunks (reference fetchChunks :470 / applyChunks :409)
         chunks: dict[int, bytes] = {}
+        #: chunk index -> the peer whose bytes we kept: a rejected
+        #: restore must cost the peers that actually served it
+        served_by: dict[int, str] = {}
         sem = asyncio.Semaphore(CHUNK_FETCHERS)
 
         async def fetch(idx: int) -> None:
@@ -357,11 +484,18 @@ class StateSyncReactor(Service):
                     try:
                         res = await asyncio.wait_for(fut, CHUNK_TIMEOUT)
                         # any reply is a healthy transport — record success
-                        # even for 'missing' so a claimed half-open probe
-                        # slot is always released
+                        # even for 'missing'/'busy' so a claimed half-open
+                        # probe slot is always released
                         br.record_success()
+                        if res.busy:
+                            # the donor's BootD shed us: backpressure,
+                            # not failure — back off and retry (same
+                            # donor stays in rotation, breaker untouched)
+                            await asyncio.sleep(FETCH_BACKOFF.sleep_for(attempt))
+                            continue
                         if not res.missing:
                             chunks[idx] = res.chunk
+                            served_by[idx] = peer
                             return
                     except asyncio.TimeoutError:
                         br.record_failure()
@@ -382,11 +516,23 @@ class StateSyncReactor(Service):
                 abci.ApplySnapshotChunkResult.ACCEPT,
                 abci.ApplySnapshotChunkResult.RETRY,
             ):
+                # poisoned snapshot/chunk: the app's hash check failed.
+                # Cost every peer whose bytes we kept a score hit + the
+                # dial quarantine, then let sync() move to the next
+                # candidate — the joiner never wedges on poison
+                await self._punish_providers(
+                    served_by.values(),
+                    f"poisoned snapshot chunk at height {snap.height}",
+                )
                 raise RuntimeError(f"chunk {idx} rejected: {res.result!r}")
 
         # verify the app actually restored the right state (syncer.go:556)
         info = await self.app_conns.query.info(abci.RequestInfo())
         if info.last_block_app_hash != app_hash:
+            await self._punish_providers(
+                served_by.values(),
+                f"restored app hash mismatch at height {snap.height}",
+            )
             raise RuntimeError(
                 f"restored app hash {info.last_block_app_hash.hex()} != "
                 f"verified {app_hash.hex()}"
@@ -456,47 +602,112 @@ class StateSyncReactor(Service):
         self.logger.warning("no peer served consensus params; using defaults")
         return ConsensusParams()
 
+    async def _fetch_backfill_window(
+        self, from_height: int, count: int
+    ) -> tuple[tuple[LightBlock, ...], str]:
+        """One batched window fetch: (light blocks descending from
+        `from_height`, serving peer), round-robining peers with the
+        single-height dispatcher as the fallback (a peer that never
+        answers the batch frame still serves the old protocol)."""
+        peers = list(self.peers)
+        for attempt, peer in enumerate(peers * 2):
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._batch_futures[from_height] = fut
+            self._send(
+                self.lb_ch,
+                m.LightBlockBatchRequest(from_height, count),
+                to=peer,
+            )
+            try:
+                lbs = await asyncio.wait_for(fut, timeout=5.0)
+                if lbs:
+                    return lbs, peer
+            except asyncio.TimeoutError:
+                await asyncio.sleep(FETCH_BACKOFF.sleep_for(attempt))
+            finally:
+                self._batch_futures.pop(from_height, None)
+        # batch path dry: one last chance via the single-height dispatcher
+        try:
+            lb = await self.dispatcher.light_block(from_height)
+            return (lb,), ""
+        except LightBlockNotFoundError:
+            return (), ""
+
     async def _backfill(
         self, from_lb: LightBlock, stop_height: int, stop_time_ns: int
     ) -> None:
-        """Reverse-fetch recent headers, verified by hash-chain linkage
-        (reference Backfill reactor.go:348,481-486 — NOT signatures).
-        Fetches until the current header is outside BOTH evidence-expiry
-        dimensions (height ≤ stop_height and time ≤ stop_time_ns), the
-        chain's base, or history runs out on every peer."""
+        """Reverse-fetch recent headers in batched windows, verified by
+        hash-chain linkage (reference Backfill reactor.go:348,481-486)
+        AND commit signatures: each window is one mega-batched funnel
+        call on the VerifyHub backfill lane (one aggregate pairing per
+        height for BLS committees) — a forged-but-linked header can no
+        longer enter the store. Fetches until the current header is
+        outside BOTH evidence-expiry dimensions (height ≤ stop_height
+        and time ≤ stop_time_ns), the chain's base, or history runs out
+        on every peer. Nothing from a window is persisted until its
+        signatures verify."""
         cur = from_lb
-        while True:
+        batch_size = min(self.bootd.backfill_batch, m.MAX_WIRE_BACKFILL_BATCH)
+        done = False
+        while not done:
             if cur.height <= stop_height and cur.header.time_ns <= stop_time_ns:
                 break
             prev_height = cur.height - 1
             if prev_height < max(1, self.initial_height):
                 break
-            # a dispatcher round can come back empty under transient load
-            # (request timeouts while the event loop is saturated) even
-            # though every peer has the header — retry the height a few
-            # times before abandoning the rest of the backfill window
-            prev = None
-            for attempt in range(3):
-                try:
-                    prev = await self.dispatcher.light_block(prev_height)
-                    break
-                except LightBlockNotFoundError:
-                    if attempt < 2:
-                        await asyncio.sleep(0.2 * (attempt + 1))
-            if prev is None:
+            window, served_peer = await self._fetch_backfill_window(
+                prev_height, batch_size
+            )
+            if not window:
                 self.logger.warning(
-                    "backfill: no peer served light block %d; stopping at %d",
-                    prev_height, cur.height,
+                    "backfill: no peer served light blocks below %d; stopping",
+                    cur.height,
                 )
                 break
-            if prev.header.hash() != cur.header.last_block_id.hash:
-                self.logger.warning("backfill hash chain broken at %d", prev_height)
+            # hash-chain check first (cheap, per link); collect the
+            # linked prefix for one batched signature verification
+            linked: list[LightBlock] = []
+            for prev in window:
+                if prev.height != cur.height - 1:
+                    break  # gap — the serving peer lacked the rest
+                if prev.header.hash() != cur.header.last_block_id.hash:
+                    self.logger.warning(
+                        "backfill hash chain broken at %d", prev.height
+                    )
+                    done = True
+                    break
+                linked.append(prev)
+                cur = prev
+                if (
+                    cur.height <= stop_height
+                    and cur.header.time_ns <= stop_time_ns
+                ) or cur.height - 1 < max(1, self.initial_height):
+                    done = True
+                    break
+            if not linked:
                 break
-            self.block_store.save_signed_header(
-                prev.header,
-                prev.signed_header.commit,
-                prev.signed_header.commit.block_id,
-            )
-            self.state_store.save_validators(prev_height, prev.validators)
-            cur = prev
+            try:
+                await verify_backfill_batch(
+                    self.chain_id, linked, bootd=self.bootd
+                )
+            except InvalidCommitError as e:
+                # a linked header with a forged commit: hub-batch
+                # verification caught what hash-chain linkage alone
+                # (the pre-BootFleet backfill) would have persisted
+                self.logger.warning(
+                    "backfill: commit verification failed below %d: %s",
+                    linked[0].height + 1, e,
+                )
+                await self._punish_providers(
+                    [served_peer] if served_peer else list(self.peers),
+                    f"forged backfill commit: {e}",
+                )
+                break
+            for prev in linked:
+                self.block_store.save_signed_header(
+                    prev.header,
+                    prev.signed_header.commit,
+                    prev.signed_header.commit.block_id,
+                )
+                self.state_store.save_validators(prev.height, prev.validators)
         self.logger.info("backfilled headers down to height %d", cur.height)

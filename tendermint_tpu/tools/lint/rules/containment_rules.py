@@ -5,8 +5,8 @@ byz-containment: the Byzantine fault-injection layers. The rule pins
 the import graph so only the scenario harness (consensus/scenarios.py)
 and the quarantined modules themselves may name them — `node.py`/
 `cli.py` can never reach them transitively (tests/test_byzantine.py
-asserts the transitive half on the real import graph). Two modules are
-quarantined:
+asserts the transitive half on the real import graph). Three modules
+are quarantined:
 
   * `consensus/byzantine.py` — a signer with NO double-sign guard plus
     a reactor send path that equivocates, withholds and lies on the
@@ -14,7 +14,10 @@ quarantined:
     traitor.
   * `light/byzantine.py` — the lunatic provider strategy: production
     code holding validator keys must be structurally unable to sign a
-    forged header for a light-client attack."""
+    forged header for a light-client attack.
+  * `statesync/byzantine.py` — the poisoned-snapshot donor app: a
+    production node must be structurally unable to serve corrupted
+    chunks to joiners."""
 
 from __future__ import annotations
 
@@ -41,6 +44,13 @@ _QUARANTINE: dict[str, tuple[str, tuple[str, ...]]] = {
             "tendermint_tpu/consensus/scenarios.py",
         ),
     ),
+    "statesync.byzantine": (
+        "byzantine",
+        (
+            "tendermint_tpu/statesync/byzantine.py",
+            "tendermint_tpu/consensus/scenarios.py",
+        ),
+    ),
 }
 
 
@@ -49,9 +59,10 @@ class ByzContainment(Rule):
     doc = (
         "the Byzantine strategy layers (consensus/byzantine: unguarded "
         "double-signing + a lying reactor send path; light/byzantine: "
-        "the lunatic forged-header provider) may only be imported by "
-        "the scenario harness and tests — production wiring must be "
-        "structurally unable to reach them"
+        "the lunatic forged-header provider; statesync/byzantine: the "
+        "poisoned-snapshot donor) may only be imported by the scenario "
+        "harness and tests — production wiring must be structurally "
+        "unable to reach them"
     )
     scope = ("tendermint_tpu/",)
     profiles = ("node",)
@@ -112,7 +123,8 @@ class ByzContainment(Rule):
                     f"import of {hit!r}: the Byzantine strategy layers are "
                     "quarantined to the scenario harness and tests — "
                     "production code must never be able to double-sign, "
-                    "lie on the wire, or forge light-client headers",
+                    "lie on the wire, forge light-client headers, or "
+                    "serve poisoned snapshot chunks",
                 )
 
 

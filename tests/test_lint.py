@@ -985,6 +985,67 @@ def test_byzantine_containment_holds_on_the_real_tree():
     assert findings == [], [f.render() for f in findings]
 
 
+def test_sync_facade_flagged_in_statesync():
+    """BootFleet put statesync/ on the fleet-serving event loop: one
+    blocking verify in a BootD coroutine stalls every concurrent chunk
+    session AND every joiner's backfill batch, so the sync facade (and
+    direct verify) is a defect there too."""
+    src = """
+    async def verify_backfill(self, blocks):
+        ok = self.hub.verify_sync(pk, msg, sig)
+        ok2 = self.hub.submit_nowait(pk, msg, sig).result(5.0)
+    """
+    fs = run(src, "verify-chokepoint", rel="tendermint_tpu/statesync/fleet.py")
+    assert len(fs) == 2
+    # sync defs in statesync/ stay legal (runs via asyncio.to_thread)
+    clean = """
+    def _check(self, pk, msg, sig):
+        return self.hub.verify_sync(pk, msg, sig)
+    """
+    assert run(clean, "verify-chokepoint", rel="tendermint_tpu/statesync/fleet.py") == []
+
+
+def test_poisoned_donor_import_flagged_in_production_code():
+    """statesync/byzantine (the poisoned-snapshot donor app) is
+    quarantined exactly like the other two strategy layers: a
+    production node must be structurally unable to serve corrupted
+    chunks to joiners."""
+    for src, rel in (
+        ("from .statesync import byzantine", "tendermint_tpu/node.py"),
+        (
+            "from .statesync.byzantine import PoisonedSnapshotApp",
+            "tendermint_tpu/node.py",
+        ),
+        (
+            "import tendermint_tpu.statesync.byzantine as sb",
+            "tendermint_tpu/cli.py",
+        ),
+        ("from .byzantine import PoisonedSnapshotApp", "tendermint_tpu/statesync/fleet.py"),
+        ("from . import byzantine", "tendermint_tpu/statesync/reactor.py"),
+    ):
+        fs = run(src, "byz-containment", rel=rel)
+        assert len(fs) == 1, (src, rel)
+        assert "quarantined" in fs[0].message
+    # the scenario harness stays the single legal injection seam
+    assert (
+        run(
+            "from ..statesync.byzantine import PoisonedSnapshotApp",
+            "byz-containment",
+            rel="tendermint_tpu/consensus/scenarios.py",
+        )
+        == []
+    )
+    # unrelated statesync imports never trip it
+    assert (
+        run(
+            "from .statesync import fleet, reactor",
+            "byz-containment",
+            rel="tendermint_tpu/node.py",
+        )
+        == []
+    )
+
+
 # ---------------------------------------------------------------------------
 # pragmas
 

@@ -201,6 +201,109 @@ def test_params_key_type_bomb_raises():
 
 
 # ---------------------------------------------------------------------------
+# statesync frames (BootFleet: every donor-supplied frame is clamped
+# before the joiner's fetch/verify loops can act on it)
+
+
+def test_snapshot_chunk_count_bomb_raises():
+    from tendermint_tpu.statesync import messages as ssm
+
+    ok = ssm.encode_message(
+        ssm.SnapshotsResponse(10, 1, 4, b"\x00" * 32)
+    )
+    assert ssm.decode_message(ok).chunks == 4
+    # a lying donor's 10-byte frame must not schedule 2^32 chunk fetches
+    body = (
+        pe.varint_field(1, 10)
+        + pe.varint_field(2, 1)
+        + pe.varint_field(3, ssm.MAX_WIRE_SNAPSHOT_CHUNKS + 1)
+    )
+    with pytest.raises(ValueError, match="exceeds"):
+        ssm.decode_message(pe.message_field(ssm.T_SNAPSHOTS_RESPONSE, body))
+
+
+def test_snapshot_hash_bomb_raises():
+    from tendermint_tpu.statesync import messages as ssm
+
+    body = pe.varint_field(1, 10) + pe.bytes_field(
+        4, b"\x00" * (ssm.MAX_WIRE_SNAPSHOT_HASH + 1)
+    )
+    with pytest.raises(ValueError, match="exceeds"):
+        ssm.decode_message(pe.message_field(ssm.T_SNAPSHOTS_RESPONSE, body))
+
+
+def test_snapshot_metadata_bomb_raises(monkeypatch):
+    from tendermint_tpu.statesync import messages as ssm
+
+    monkeypatch.setattr(ssm, "MAX_WIRE_SNAPSHOT_METADATA", 16)
+    body = pe.varint_field(1, 10) + pe.bytes_field(5, b"\x00" * 17)
+    with pytest.raises(ValueError, match="exceeds"):
+        ssm.decode_message(pe.message_field(ssm.T_SNAPSHOTS_RESPONSE, body))
+
+
+def test_chunk_payload_bomb_raises(monkeypatch):
+    from tendermint_tpu.statesync import messages as ssm
+
+    ok = ssm.encode_message(ssm.ChunkResponse(10, 1, 0, b"x" * 64))
+    assert ssm.decode_message(ok).chunk == b"x" * 64
+    monkeypatch.setattr(ssm, "MAX_WIRE_CHUNK", 64)
+    body = (
+        pe.varint_field(1, 10)
+        + pe.varint_field(2, 1)
+        + pe.varint_field(3, 0)
+        + pe.bytes_field(4, b"x" * 65)
+    )
+    with pytest.raises(ValueError, match="exceeds"):
+        ssm.decode_message(pe.message_field(ssm.T_CHUNK_RESPONSE, body))
+
+
+def test_chunk_busy_flag_roundtrips():
+    """`busy` (the BootD shed signal) must survive the wire and stay
+    distinct from `missing` — conflating them would steer the fetcher
+    away from a healthy-but-loaded donor."""
+    from tendermint_tpu.statesync import messages as ssm
+
+    res = ssm.decode_message(
+        ssm.encode_message(ssm.ChunkResponse(10, 1, 2, busy=True))
+    )
+    assert res.busy and not res.missing
+    res = ssm.decode_message(
+        ssm.encode_message(ssm.ChunkResponse(10, 1, 2, missing=True))
+    )
+    assert res.missing and not res.busy
+
+
+def test_backfill_batch_request_bomb_raises():
+    from tendermint_tpu.statesync import messages as ssm
+
+    ok = ssm.encode_message(ssm.LightBlockBatchRequest(100, 64))
+    assert ssm.decode_message(ok).count == 64
+    body = pe.varint_field(1, 100) + pe.varint_field(
+        2, ssm.MAX_WIRE_BACKFILL_BATCH + 1
+    )
+    with pytest.raises(ValueError, match="exceeds"):
+        ssm.decode_message(
+            pe.message_field(ssm.T_LIGHT_BLOCK_BATCH_REQUEST, body)
+        )
+
+
+def test_backfill_batch_response_bomb_raises(monkeypatch):
+    from tendermint_tpu.statesync import messages as ssm
+
+    ok = ssm.encode_message(ssm.LightBlockBatchResponse(()))
+    assert ssm.decode_message(ok).light_blocks == ()
+    # the list-length guard fires BEFORE the excess element is decoded,
+    # so at a patched bound of 0 the first field must raise even though
+    # its payload is not a valid LightBlock
+    monkeypatch.setattr(ssm, "MAX_WIRE_BACKFILL_BATCH", 0)
+    bomb = pe.message_field(
+        ssm.T_LIGHT_BLOCK_BATCH_RESPONSE, pe.message_field(1, b"junk")
+    )
+    with pytest.raises(ValueError, match="exceeds"):
+        ssm.decode_message(bomb)
+
+
+# ---------------------------------------------------------------------------
 # the transitive-blocking sweep: the split probe API
 
 
