@@ -775,6 +775,140 @@ def bench_byz_soak(sizes: tuple = (4, 50)) -> dict:
     return out
 
 
+def bench_routernet_xl(rows: tuple = ((50, 2),)) -> dict:
+    """routernet_xl config: multi-process committees over real sockets
+    (consensus/routernet_xl) measured per round. Each headline row is
+    (validators × worker processes) over TCP with the full
+    SecretConnection handshake on every cross-slice link, one shared
+    verifyd sidecar (all workers pointed at it via TMTPU_VERIFYD_SOCK),
+    and a mid-run SIGKILL + respawn of the last worker — so a row
+    yields blocks/s, time-to-recover (WAL repair + re-handshake +
+    catch-up across a process boundary), and the daemon's cross-tenant
+    occupancy. A small-committee transport A/B (TCP vs UDS at 2 workers
+    vs in-process memory at 1 worker — memory links cannot cross a
+    process) isolates the socket tax. BOUNDED, structured outcomes (the
+    chaos_soak discipline): XLNet's aggregated liveness watchdog plus
+    an outer asyncio timeout make a wedge, a torn worker, or a timeout
+    a record, never a hang. Rows default to 50×2 on CPU;
+    TMTPU_BENCH_XL_ROWS (e.g. "50:2,150:4,500:4") widens to the paper's
+    150/500-validator scales."""
+    import asyncio
+
+    from tendermint_tpu.consensus import routernet_xl as xl
+    from tendermint_tpu.consensus.scenarios import Event
+
+    seed = int(os.environ.get("TMTPU_BENCH_XL_SEED", "7") or 7)
+    out: dict = {"seed": seed, "rows": [], "transport_ab": []}
+
+    def budget(n_vals: int) -> tuple[float, float, float]:
+        """(timeout_s, stall_s, time_scale) by committee size — the
+        slow-soak envelopes from tests/test_routernet_xl.py."""
+        if n_vals <= 8:
+            return 180.0, 60.0, 1.0
+        if n_vals <= 64:
+            return 420.0, 150.0, 4.0
+        if n_vals <= 200:
+            return 900.0, 300.0, 8.0
+        return 3000.0, 900.0, 15.0
+
+    def one(label: str, **kw) -> dict:
+        t0 = time.perf_counter()
+        to = kw.get("timeout_s", 300.0)
+        try:
+            res = asyncio.run(
+                asyncio.wait_for(xl.run_xl(**kw), to + 120.0)
+            )
+            rec = {
+                k: res.get(k)
+                for k in (
+                    "outcome",
+                    "scenario",
+                    "n_vals",
+                    "workers",
+                    "transport",
+                    "blocks_per_s",
+                    "recover_s",
+                    "honest_min",
+                    "elapsed_s",
+                    "process_events_applied",
+                    "verifyd",
+                    "worker_errors",
+                )
+            }
+            rec["audit_ok"] = bool((res.get("audit") or {}).get("ok"))
+        except Exception as e:  # noqa: BLE001 — structured outcome
+            rec = {"outcome": f"error: {e!r}"[:200]}
+        rec["label"] = label
+        rec["wall_s"] = round(time.perf_counter() - t0, 2)
+        rec_r = rec.get("recover_s")
+        log(
+            f"routernet_xl {label:<22} {rec.get('outcome', '?'):<7} "
+            f"{rec.get('blocks_per_s', 0)} blk/s "
+            f"recover={'-' if rec_r is None else f'{rec_r}s'} "
+            f"wall={rec['wall_s']}s"
+        )
+        return rec
+
+    # headline rows: blocks/s + time-to-recover + verifyd occupancy at
+    # each (validators × workers) scale, kill+respawn of the last worker
+    for n_vals, workers in rows:
+        to, stall, scale = budget(n_vals)
+        out["rows"].append(
+            one(
+                f"{n_vals}v x{workers}w tcp",
+                scenario="baseline",
+                n_vals=n_vals,
+                workers=workers,
+                transport="tcp",
+                seed=seed,
+                target_height=2,
+                preload=4,
+                timeout_s=to,
+                stall_s=stall,
+                time_scale=scale,
+                use_verifyd=True,
+                durable=True,
+                # 1-core boxes need slower, bigger-batch gossip at
+                # committee scale (see the 500-val soak test)
+                gossip_sleep=1.0 if n_vals > 200 else None,
+                process_events=(
+                    Event(2.0, "kill_worker", node=workers - 1),
+                    Event(4.0, "restart_worker", node=workers - 1),
+                ),
+            )
+        )
+    # transport A/B at a small committee: the socket tax isolated from
+    # committee-scale costs. memory runs 1 worker — in-process links
+    # only — and is the A/B's no-socket control.
+    ab_vals = int(os.environ.get("TMTPU_BENCH_XL_AB_VALS", "4"))
+    to, stall, scale = budget(ab_vals)
+    for transport, workers in (("tcp", 2), ("unix", 2), ("memory", 1)):
+        out["transport_ab"].append(
+            one(
+                f"{ab_vals}v x{workers}w {transport}",
+                scenario="baseline",
+                n_vals=ab_vals,
+                workers=workers,
+                transport=transport,
+                seed=seed,
+                target_height=3,
+                preload=4,
+                timeout_s=to,
+                stall_s=stall,
+                time_scale=scale,
+                durable=False,
+            )
+        )
+    ok = [
+        r
+        for r in out["rows"] + out["transport_ab"]
+        if r.get("outcome") == "ok"
+    ]
+    out["ok_runs"] = len(ok)
+    out["total_runs"] = len(out["rows"]) + len(out["transport_ab"])
+    return out
+
+
 def bench_verify_hub(
     n_vals: int, n_submitters: int = 8, per_submitter: int = 200
 ) -> dict:
@@ -2231,6 +2365,24 @@ def main() -> None:
             extra["byz_soak"] = bench_byz_soak(byz_vals)
         except Exception as e:  # noqa: BLE001
             log(f"byz-soak bench failed: {e!r}")
+    # routernet_xl runs on BOTH backends, BOUNDED: multi-process
+    # committees over real TCP/UDS sockets — blocks/s + time-to-recover
+    # from a SIGKILLed worker per (validators × workers) row, the
+    # TCP vs UDS vs memory transport A/B, and shared-verifyd occupancy.
+    # Worker processes are spawned with JAX_PLATFORMS=cpu; the bench
+    # process's device is not on this path.
+    if os.environ.get("TMTPU_BENCH_ROUTERNET_XL") != "0":
+        try:
+            xl_rows = tuple(
+                (int(r.split(":")[0]), int(r.split(":")[1]))
+                for r in os.environ.get(
+                    "TMTPU_BENCH_XL_ROWS", "50:2"
+                ).split(",")
+                if r.strip()
+            )
+            extra["routernet_xl"] = bench_routernet_xl(xl_rows)
+        except Exception as e:  # noqa: BLE001
+            log(f"routernet-xl bench failed: {e!r}")
     # commit_ab runs on BOTH backends: the aggregate-signature A/B —
     # EdDSA-batch vs BLS-aggregate on the same 150-validator chain
     # (commit wire bytes x verify sigs/s x catch-up blocks/s). On CPU

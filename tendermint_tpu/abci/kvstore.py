@@ -3,7 +3,9 @@ and persistent_kvstore.go:38, plus the snapshot support of the e2e app
 test/e2e/app/{app,snapshots}.go).
 
 Tx format: `key=value` stores a pair; `val:<hex ed25519 pubkey>!<power>`
-requests a validator-set change at EndBlock (power 0 removes). App hash is
+(or typed: `val:<keytype>:<hex pubkey>!<power>[!<hex pop>]`) requests a
+validator-set change at EndBlock (power 0 removes; bls12381 joins must
+carry a valid proof of possession or the tx is rejected). App hash is
 the SHA-256 of the deterministic encoding of the full kv state, so two
 replicas agree iff their states agree. Snapshots serialize the state into
 fixed-size chunks keyed by (height, format, chunk)."""
@@ -211,18 +213,47 @@ class KVStoreApp(BaseApplication):
 
     @staticmethod
     def _parse_validator_tx(tx: bytes) -> abci.ValidatorUpdate:
+        """`val:<hex pubkey>!<power>` (legacy, ed25519) or
+        `val:<keytype>:<hex pubkey>!<power>[!<hex pop>]`.
+
+        bls12381 joins (power > 0) MUST carry a valid proof of
+        possession: rejecting the rogue key HERE — CheckTx keeps it out
+        of mempools, DeliverTx returns code 2 — is what keeps the
+        state/execution.validator_updates_to_validators backstop from
+        ever firing inside apply_block (where a raise would wedge every
+        replica). The app layer is the live PoP-on-update defense; the
+        execution check is the invariant of last resort."""
         body = tx[len(VALIDATOR_TX_PREFIX) :]
         if b"!" not in body:
             raise ValueError("validator tx must be val:<hex pubkey>!<power>")
-        pk_hex, power_s = body.split(b"!", 1)
+        key_part, _, rest = body.partition(b"!")
+        if b":" in key_part:
+            type_b, _, pk_hex = key_part.partition(b":")
+            key_type = type_b.decode(errors="replace")
+        else:
+            key_type, pk_hex = "ed25519", key_part
+        power_s, _, pop_hex = rest.partition(b"!")
         try:
             pub_key = bytes.fromhex(pk_hex.decode())
             power = int(power_s)
+            pop = bytes.fromhex(pop_hex.decode()) if pop_hex else b""
         except Exception:
             raise ValueError("bad validator tx encoding") from None
-        if len(pub_key) != 32 or power < 0:
-            raise ValueError("bad pubkey size or negative power")
-        return abci.ValidatorUpdate("ed25519", pub_key, power)
+        if power < 0:
+            raise ValueError("negative power")
+        from .. import crypto
+
+        try:
+            pub = crypto.pubkey_from_type_and_bytes(key_type, pub_key)
+        except Exception as e:
+            raise ValueError(f"bad validator pubkey: {e}") from None
+        if power > 0 and key_type == "bls12381":
+            if not pop or not pub.pop_verify(pop):
+                raise ValueError(
+                    "bls12381 validator join without a valid proof of "
+                    "possession"
+                )
+        return abci.ValidatorUpdate(key_type, pub_key, power, pop)
 
     # -- snapshots --------------------------------------------------------
 

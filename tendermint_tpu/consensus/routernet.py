@@ -141,6 +141,9 @@ class RouterNode:
             moniker=f"rn{index}",
             max_connected=max(64, net.degree * 4),
             peer_queue_size=net.queue_size * 2,
+            # routernet_xl: a per-node TCP/UDS transport for cross-process
+            # links (chaos-wrapped by the shell like the memory transport)
+            extra_transports=net._extra_transports_for(index),
         )
         self.node_id = self.shell.node_id
         clock = net._clock_for(self.node_id)
@@ -375,9 +378,9 @@ class RouterNet:
         self.catchup_burst = catchup_burst
         self._fs: dict[int, object] = {}
         self.edges = topology_edges(self.n, degree, topo_seed)
-        self.nodes: list[RouterNode] = [
-            self._build_node(i) for i in range(self.n)
-        ]
+        # construction hook: routernet_xl's worker slice overrides this
+        # to build only the node indices its process hosts
+        self.nodes: list[RouterNode] = self._build_nodes()
         # cold nodes built by make_joiner(): stopped with the net but
         # deliberately NOT in self.nodes — heights()/wait_for_height
         # measure the committee, and a joiner mid-statesync has no height
@@ -399,6 +402,14 @@ class RouterNet:
                 self._fs_factory(i) if self._fs_factory is not None else None
             )
         return self._fs[i]
+
+    def _build_nodes(self) -> list[RouterNode]:
+        return [self._build_node(i) for i in range(self.n)]
+
+    def _extra_transports_for(self, index: int) -> list:
+        """Additional (socket) transports for node `index` — the
+        routernet_xl worker-slice seam; in-process nets run none."""
+        return []
 
     def _build_node(
         self, i: int, *, app=None, block_store=None, state_store=None,

@@ -48,12 +48,16 @@ class Event:
     150-validator block cadences)."""
 
     at_s: float
-    action: str  # partition | oneway | heal | gray | ungray | crash | restart
+    # partition | oneway | heal | gray | ungray | crash | restart |
+    # churn_join | churn_leave | churn_power | churn_rogue_join
+    action: str
     groups: tuple = ()  # partition: tuple of groups (indices or "rest")
     src: tuple = ()  # oneway: sender group (indices or "rest")
     dst: tuple = ()  # oneway: receiver group
-    node: int = 0  # gray/ungray/crash/restart target (index mod n)
+    node: int = 0  # gray/ungray/crash/restart/churn target (index mod n;
+    # for churn_join/churn_rogue_join it seeds the PHANTOM key instead)
     delay_ms: float = 0.0  # gray: fixed per-message delay
+    power: int = 1  # churn_join/churn_power: requested voting power
 
 
 @dataclass(frozen=True)
@@ -149,6 +153,21 @@ SCENARIOS: dict[str, Scenario] = {
             events=(
                 Event(1.2, "crash", node=-1),
                 Event(2.0, "restart", node=-1),
+            ),
+        ),
+        Scenario(
+            "validator_churn",
+            "live validator-set churn under lossy links: a phantom key "
+            "joins via a val-tx, a sitting validator's power shifts, "
+            "the last validator leaves (power 0), and a rogue bls12381 "
+            "join WITHOUT proof of possession bounces off every mempool "
+            "(the PR 9 PoP-on-update defense, exercised live)",
+            chaos=ChaosConfig(drop_rate=0.02, delay_ms=3.0),
+            events=(
+                Event(0.6, "churn_join", node=100, power=1),
+                Event(1.2, "churn_rogue_join", node=101, power=1),
+                Event(1.8, "churn_power", node=1, power=3),
+                Event(2.4, "churn_leave", node=-1),
             ),
         ),
         # -- the Byzantine axis: validators that LIE, composed with the
@@ -342,11 +361,89 @@ def _event_indices(ev: Event, n: int) -> set[int]:
     return named
 
 
-async def _apply_event(ev: Event, net: RouterNet, chaos: ChaosNetwork) -> None:
+def churn_join_key(seed: int, index: int):
+    """The deterministic phantom validator key a `churn_join` event
+    introduces — a pure function of (run seed, event node index) so
+    every process in a multi-worker run derives the same key."""
+    import hashlib
+
+    from ..crypto import ed25519 as _ed
+
+    return _ed.Ed25519PrivKey(
+        hashlib.sha256(f"tmtpu:churn:{seed}:{index}".encode()).digest()
+    )
+
+
+async def _inject_tx(net: RouterNet, tx: bytes, *, expect_reject: bool) -> None:
+    """Broadcast one tx into every live node's mempool (RouterNet wires
+    no mempool gossip channel, so whichever validator proposes next must
+    already hold the tx). `expect_reject` inverts the contract: the tx
+    MUST bounce off CheckTx on every node — the live PoP-on-update
+    defense — and acceptance anywhere is the failure."""
+    from ..mempool.pool import TxInCacheError, TxRejectedError
+
+    accepted = rejected = 0
+    for node in net.nodes:
+        inner = node.inner
+        if inner is None or inner.mempool is None:
+            continue  # crashed mid-scenario; survivors carry the churn
+        try:
+            await inner.mempool.check_tx(tx)
+            accepted += 1
+        except TxRejectedError:
+            rejected += 1
+        except TxInCacheError:
+            accepted += 1
+    if expect_reject:
+        if accepted:
+            raise AssertionError(
+                f"rogue churn tx accepted by {accepted} mempools"
+            )
+    elif not accepted:
+        raise AssertionError(f"churn tx rejected by all {rejected} mempools")
+
+
+def _churn_tx(ev: Event, net: RouterNet, seed: int) -> tuple[bytes, bool]:
+    """Build the validator-tx for a churn event; returns (tx,
+    expect_reject)."""
+    from ..abci.kvstore import VALIDATOR_TX_PREFIX
+
+    if ev.action == "churn_join":
+        pub = churn_join_key(seed, ev.node).pub_key()
+        body = f"{pub.bytes().hex()}!{ev.power}"
+        return VALIDATOR_TX_PREFIX + body.encode(), False
+    if ev.action == "churn_rogue_join":
+        # a bls12381 join WITHOUT proof of possession: the rogue-key
+        # shape PR 9 closed at genesis, now arriving through the only
+        # post-genesis entry point — every mempool must bounce it
+        from ..crypto import bls
+        import hashlib
+
+        priv = bls.BLSPrivKey(
+            hashlib.sha256(f"tmtpu:rogue:{seed}:{ev.node}".encode()).digest()
+        )
+        body = f"bls12381:{priv.pub_key().bytes().hex()}!{ev.power}"
+        return VALIDATOR_TX_PREFIX + body.encode(), True
+    # churn_leave / churn_power target a SITTING validator by index
+    pub = net.keys[ev.node % net.n].pub_key()
+    power = 0 if ev.action == "churn_leave" else ev.power
+    if pub.TYPE == "ed25519":
+        body = f"{pub.bytes().hex()}!{power}"
+    else:
+        body = f"{pub.TYPE}:{pub.bytes().hex()}!{power}"
+    return VALIDATOR_TX_PREFIX + body.encode(), False
+
+
+async def _apply_event(
+    ev: Event, net: RouterNet, chaos: ChaosNetwork, seed: int = 0
+) -> None:
     n = net.n
     named = _event_indices(ev, n)
     ids = lambda idxs: {net.nodes[i].node_id for i in idxs}  # noqa: E731
-    if ev.action == "partition":
+    if ev.action.startswith("churn_"):
+        tx, expect_reject = _churn_tx(ev, net, seed)
+        await _inject_tx(net, tx, expect_reject=expect_reject)
+    elif ev.action == "partition":
         chaos.partition(
             *(ids(_resolve_group(g, n, named)) for g in ev.groups)
         )
@@ -564,7 +661,7 @@ async def run_scenario(
                 max(0.0, ev.at_s * time_scale - (loop.time() - t0))
             )
             try:
-                await _apply_event(ev, net, chaos)
+                await _apply_event(ev, net, chaos, seed)
                 events_applied.append(ev.action)
             except Exception as e:  # noqa: BLE001 — recorded, run continues
                 event_err.append(f"{ev.action}@{ev.at_s}: {e!r}")

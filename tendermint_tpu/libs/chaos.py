@@ -76,6 +76,14 @@ class ChaosConfig:
     clock_drift: float = 0.0
     # channel_id -> rate overrides, e.g. {0x40: ChaosConfig(drop_rate=0.5)}
     per_channel: dict = field(default_factory=dict)
+    # per-link RNG streams instead of the one shared stream: every
+    # (src, dst) link draws from random.Random(f"{seed}:{src}:{dst}"),
+    # so a link's fault schedule depends only on ITS OWN message
+    # sequence — the cross-process determinism contract RouterNet-XL
+    # needs (K worker processes each own the send side of their links;
+    # no shared RNG can span them). In-process harnesses keep the
+    # shared stream by default: existing seeds pin existing schedules.
+    link_seeded: bool = False
 
     @classmethod
     def from_env(cls) -> "ChaosConfig":
@@ -132,6 +140,8 @@ class ChaosNetwork:
     def __init__(self, config: ChaosConfig | None = None):
         self.config = config or ChaosConfig()
         self.rng = random.Random(self.config.seed)
+        # link_seeded mode: lazily-built per-link RNGs (see ChaosConfig)
+        self._link_rngs: dict[tuple[str, str], random.Random] = {}
         self._groups: list[set[str]] = []
         self._oneway: list[tuple[set[str], set[str]]] = []  # (src, dst) blocked
         self._per_peer: dict[str, ChaosConfig] = {}
@@ -234,7 +244,15 @@ class ChaosNetwork:
         if self.partitioned_oneway(local, remote):
             self.faults["asym_drop"] += 1
             return _Faults(drop=True)
-        rng = self.rng
+        if self.config.link_seeded:
+            rng = self._link_rngs.get((local, remote))
+            if rng is None:
+                rng = random.Random(
+                    f"{self.config.seed}:{local}:{remote}"
+                )
+                self._link_rngs[(local, remote)] = rng
+        else:
+            rng = self.rng
         drop = cfg.drop_rate > 0 and rng.random() < cfg.drop_rate
         if drop:
             self.faults["drop"] += 1

@@ -49,6 +49,12 @@ DATA_MAX_SIZE = 1024
 FRAME_SIZE = DATA_LEN_SIZE + DATA_MAX_SIZE  # plaintext frame
 SEALED_FRAME_SIZE = FRAME_SIZE + 16  # + poly1305 tag
 HKDF_INFO = b"TENDERMINT_SECRET_CONNECTION_KEY_AND_CHALLENGE_GEN"
+# handshake frame bounds (the never-load-tested path hardened for
+# RouterNet-XL): the cleartext ephemeral key is exactly 32 bytes and
+# the encrypted auth frame (pubkey + challenge signature, protoenc) is
+# ~100 bytes — reject anything bigger BEFORE allocating for it
+EPH_KEY_LEN = 32
+MAX_AUTH_FRAME = 512
 
 
 class AuthError(ConnectionError):
@@ -92,9 +98,11 @@ class SecretStream:
         self._writer.write(struct.pack(">H", len(eph_pub)) + eph_pub)
         await self._writer.drain()
         (n,) = struct.unpack(">H", await self._reader.readexactly(2))
-        if n != 32:
+        if n != EPH_KEY_LEN:
+            # a torn or hostile dialer: refuse before reading a single
+            # byte of whatever it claims to be sending
             raise AuthError("bad ephemeral key length")
-        their_eph = await self._reader.readexactly(32)
+        their_eph = await self._reader.readexactly(EPH_KEY_LEN)
 
         shared = eph_priv.exchange(X25519PublicKey.from_public_bytes(their_eph))
         loc_is_least = eph_pub < their_eph
@@ -110,6 +118,8 @@ class SecretStream:
         # prove node identity over the encrypted link
         sig = priv_key.sign(challenge)
         auth = pe.bytes_field(1, priv_key.pub_key().bytes()) + pe.bytes_field(2, sig)
+        if len(auth) > MAX_AUTH_FRAME:
+            raise AuthError("auth frame exceeds handshake bound")
         await self.write_all(auth)
         their_auth = await self.read_exactly(len(auth))
         r = pe.Reader(their_auth)

@@ -38,6 +38,10 @@ class RouterShell:
         moniker: str = "",
         max_connected: int = 64,
         peer_queue_size: int = 4096,
+        # additional transports (e.g. a TCP/UDS socket transport for
+        # RouterNet-XL inter-process links) — chaos-wrapped like the
+        # memory transport, registered on the router by PROTOCOL
+        extra_transports: list | None = None,
     ):
         self.index = index
         self.priv_key = ed25519.Ed25519PrivKey(
@@ -53,6 +57,10 @@ class RouterShell:
         self.transport = (
             chaos.wrap(inner, self.node_id) if chaos is not None else inner
         )
+        self.extra_transports = [
+            chaos.wrap(t, self.node_id) if chaos is not None else t
+            for t in (extra_transports or [])
+        ]
         self.peer_manager = PeerManager(
             self.node_id, max_connected=max_connected
         )
@@ -60,7 +68,7 @@ class RouterShell:
             self.node_info,
             self.priv_key,
             self.peer_manager,
-            [self.transport],
+            [self.transport, *self.extra_transports],
             peer_queue_size=peer_queue_size,
         )
 

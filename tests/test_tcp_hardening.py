@@ -1,0 +1,331 @@
+"""Hardening pins for the TCP/SecretConnection stack (ISSUE 18
+satellites 1+2): the socket layer now carries real consensus load
+across processes, so the handshake path is bounded and deadlined, a
+full accept queue sheds instead of blocking, and silent links die on a
+pong deadline instead of trusting the kernel's ACK machinery."""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import struct
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tendermint_tpu.crypto import ed25519
+from tendermint_tpu.p2p import secret as secretmod
+from tendermint_tpu.p2p.secret import SecretStream
+from tendermint_tpu.p2p.tcp import (
+    MAX_HANDSHAKE_MSG_SIZE,
+    TCPTransport,
+    UDSTransport,
+    _T_DATA,
+)
+from tendermint_tpu.p2p.transport import ConnectionClosedError
+from tendermint_tpu.p2p.types import NodeAddress, NodeInfo, node_id_from_pubkey
+
+
+def _identity(tag: str):
+    priv = ed25519.Ed25519PrivKey(bytes([len(tag)]) * 31 + tag.encode()[:1])
+    nid = node_id_from_pubkey(priv.pub_key())
+    return priv, nid, NodeInfo(node_id=nid, network="hardening")
+
+
+async def _listening(transport_cls=TCPTransport, **kwargs):
+    t = transport_cls(**kwargs)
+    await t.listen("127.0.0.1:0")
+    return t
+
+
+class TestHandshakeHardening:
+    @pytest.mark.asyncio
+    async def test_torn_handshake_times_out_and_cleans_up(self):
+        """A dialer that connects, sends two bytes, and stalls must cost
+        the acceptor one bounded handshake deadline — not a forever-
+        parked reader task pinning the accept slot."""
+        priv, _nid, info = _identity("srv")
+        t = await _listening(handshake_timeout=0.4)
+        host, port = t.endpoint().rsplit(":", 1)
+
+        # raw socket: open, write a torn ephemeral-key header, stall
+        reader, writer = await asyncio.open_connection(host, int(port))
+
+        async def server():
+            conn = await t.accept()
+            with pytest.raises(ConnectionError, match="handshake timed out"):
+                await conn.handshake(info, priv)
+
+        stask = asyncio.create_task(server())
+        writer.write(b"\x00")  # half of the 2-byte length prefix
+        await writer.drain()
+        await asyncio.wait_for(stask, 5.0)
+        # the acceptor closed its side: after its own ephemeral-key
+        # bytes, our raw socket drains to EOF
+        assert await asyncio.wait_for(reader.read(), 5.0) is not None
+        assert reader.at_eof()
+        writer.close()
+        await t.close()
+
+    @pytest.mark.asyncio
+    async def test_bad_ephemeral_key_length_rejected(self):
+        """The cleartext ephemeral key is exactly 32 bytes; a hostile
+        length claim is refused before any allocation."""
+        priv, _nid, info = _identity("srv")
+        t = await _listening()
+        host, port = t.endpoint().rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+
+        async def server():
+            conn = await t.accept()
+            with pytest.raises(ConnectionError):
+                await conn.handshake(info, priv)
+
+        stask = asyncio.create_task(server())
+        writer.write(struct.pack(">H", 60000) + b"\x00" * 64)
+        await writer.drain()
+        await asyncio.wait_for(stask, 5.0)
+        writer.close()
+        await t.close()
+
+    @pytest.mark.asyncio
+    async def test_oversized_handshake_frame_rejected(self):
+        """A peer that completes the secret handshake but then claims a
+        multi-megabyte NodeInfo gets the 64 KiB handshake bound, not the
+        32 MiB data bound."""
+        priv_s, _nid, info = _identity("srv")
+        priv_c, _cid, _cinfo = _identity("cli")
+        t = await _listening(handshake_timeout=5.0)
+        host, port = t.endpoint().rsplit(":", 1)
+
+        async def server():
+            conn = await t.accept()
+            with pytest.raises(ConnectionError, match="oversized message"):
+                await conn.handshake(info, priv_s)
+
+        stask = asyncio.create_task(server())
+        reader, writer = await asyncio.open_connection(host, int(port))
+        stream = SecretStream(reader, writer)
+        await stream.handshake(priv_c)
+        # valid frame header claiming a bomb-sized NodeInfo
+        hdr = struct.pack(">BBI", _T_DATA, 0xFF, MAX_HANDSHAKE_MSG_SIZE + 1)
+        await stream.write_all(hdr)
+        await asyncio.wait_for(stask, 5.0)
+        stream.close()
+        await t.close()
+
+    @pytest.mark.asyncio
+    async def test_oversized_auth_frame_rejected(self, monkeypatch):
+        """The encrypted auth frame (pubkey + challenge signature) is
+        ~100 bytes; the sender refuses to emit one past MAX_AUTH_FRAME."""
+        monkeypatch.setattr(secretmod, "MAX_AUTH_FRAME", 8)
+        priv_s, _nid, _info = _identity("srv")
+        priv_c, _cid, _cinfo = _identity("cli")
+
+        async def _peer(r, w):
+            s = SecretStream(r, w)
+            try:
+                await s.handshake(priv_s)
+            except (secretmod.AuthError, OSError, EOFError):
+                pass  # the dialer aborts first
+            s.close()
+
+        server = await asyncio.start_server(_peer, "127.0.0.1", 0)
+        host, port = server.sockets[0].getsockname()[:2]
+        reader, writer = await asyncio.open_connection(host, port)
+        stream = SecretStream(reader, writer)
+        with pytest.raises(secretmod.AuthError, match="handshake bound"):
+            await stream.handshake(priv_c)
+        stream.close()
+        server.close()
+
+    @pytest.mark.asyncio
+    async def test_accept_queue_sheds_on_flood(self):
+        """A dial flood past the accept backlog sheds the excess sockets
+        (they see EOF and own their redial) instead of blocking the
+        asyncio server callback."""
+        t = await _listening(accept_backlog=2)
+        host, port = t.endpoint().rsplit(":", 1)
+        socks = []
+        for _ in range(6):
+            socks.append(await asyncio.open_connection(host, int(port)))
+        # give the server callbacks a chance to run
+        for _ in range(50):
+            if t.sheds >= 4:
+                break
+            await asyncio.sleep(0.02)
+        assert t.sheds >= 4
+        # shed sockets see EOF; queued ones stay open
+        eofs = 0
+        for r, w in socks:
+            try:
+                data = await asyncio.wait_for(r.read(1), 0.5)
+                if data == b"":
+                    eofs += 1
+            except asyncio.TimeoutError:
+                pass
+            w.close()
+        assert eofs >= 4
+        await t.close()
+
+    @pytest.mark.asyncio
+    async def test_transport_close_drains_queued_conns(self):
+        """Sockets accepted but never claimed by the router are closed
+        with the transport — no leaked reader tasks."""
+        t = await _listening(accept_backlog=4)
+        host, port = t.endpoint().rsplit(":", 1)
+        r1, w1 = await asyncio.open_connection(host, int(port))
+        for _ in range(50):
+            if t._accept_q.qsize() >= 1:
+                break
+            await asyncio.sleep(0.02)
+        await t.close()
+        assert await asyncio.wait_for(r1.read(16), 5.0) == b""
+        w1.close()
+        with pytest.raises(ConnectionClosedError):
+            await t.accept()
+
+
+class TestUDSTransport:
+    @pytest.mark.asyncio
+    async def test_uds_dial_handshake_exchange(self, tmp_path):
+        """Full SecretConnection handshake + framed exchange over a
+        Unix-domain socket — the XL same-host inter-process link."""
+        priv_a, id_a, info_a = _identity("ua")
+        priv_b, id_b, info_b = _identity("ub")
+        sock = str(tmp_path / "xl.sock")
+        tb = UDSTransport()
+        await tb.listen(sock)
+
+        async def server():
+            conn = await tb.accept()
+            peer = await conn.handshake(info_b, priv_b)
+            assert peer.node_id == id_a
+            ch, data = await conn.receive_message()
+            await conn.send_message(ch, data.upper())
+            return conn
+
+        stask = asyncio.create_task(server())
+        ta = UDSTransport()
+        conn = await ta.dial(NodeAddress(node_id=id_b, host=sock, port=0))
+        peer = await conn.handshake(info_a, priv_a)
+        assert peer.node_id == id_b
+        await conn.send_message(0x30, b"uds")
+        ch, data = await conn.receive_message()
+        assert (ch, data) == (0x30, b"UDS")
+        sconn = await asyncio.wait_for(stask, 5.0)
+        await conn.close()
+        await sconn.close()
+        await ta.close()
+        await tb.close()
+
+    def test_uds_address_roundtrip(self, tmp_path):
+        a = NodeAddress(
+            node_id="ab" * 20, protocol="unix",
+            host=str(tmp_path / "n3.sock"), port=0,
+        )
+        assert NodeAddress.parse(str(a)) == a
+
+
+_STOPPED_PEER = textwrap.dedent(
+    """
+    import asyncio, os, sys
+    from tendermint_tpu.crypto import ed25519
+    from tendermint_tpu.p2p.tcp import TCPTransport
+    from tendermint_tpu.p2p.types import NodeAddress, NodeInfo, node_id_from_pubkey
+
+    async def main():
+        host, port = sys.argv[1], int(sys.argv[2])
+        priv = ed25519.Ed25519PrivKey(bytes([7]) * 31 + b"c")
+        nid = node_id_from_pubkey(priv.pub_key())
+        info = NodeInfo(node_id=nid, network="hardening")
+        t = TCPTransport(ping_interval=0.1, pong_timeout=1e9)
+        conn = await t.dial(NodeAddress(node_id="", host=host, port=port))
+        await conn.handshake(info, priv)
+        print("READY", flush=True)
+        # freeze this whole process: the kernel keeps ACKing the
+        # parent's bytes but no pong ever comes back
+        os.kill(os.getpid(), 19)  # SIGSTOP
+        await conn.receive_message()
+
+    asyncio.run(main())
+    """
+)
+
+
+class TestDeadPeerDetection:
+    @pytest.mark.asyncio
+    async def test_sigstopped_peer_disconnects_on_pong_deadline(self):
+        """A SIGSTOPped peer process never answers pings even though its
+        kernel ACKs every byte — only the pong deadline notices, and it
+        closes the connection explicitly (router reconnect owns retry)."""
+        priv, _nid, info = _identity("srv")
+        t = await _listening(ping_interval=0.2, pong_timeout=0.6)
+        host, port = t.endpoint().rsplit(":", 1)
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env.setdefault("TMTPU_DISABLE_TPU", "1")
+        proc = await asyncio.to_thread(
+            subprocess.Popen,
+            [sys.executable, "-c", _STOPPED_PEER, host, port],
+            stdout=subprocess.PIPE,
+            env=env,
+            start_new_session=True,
+        )
+        try:
+            conn = await asyncio.wait_for(t.accept(), 30.0)
+            await asyncio.wait_for(conn.handshake(info, priv), 30.0)
+            # wait for the child to announce it froze itself
+            line = await asyncio.wait_for(
+                asyncio.to_thread(proc.stdout.readline), 30.0
+            )
+            assert b"READY" in line
+            with pytest.raises(ConnectionClosedError, match="pong timeout"):
+                # next frames never come; the ping loop must kill the
+                # link within ~pong_timeout + one ping interval
+                await asyncio.wait_for(conn.receive_message(), 10.0)
+            assert conn.close_reason == "pong timeout"
+        finally:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            await asyncio.to_thread(proc.wait)
+            await t.close()
+
+    @pytest.mark.asyncio
+    async def test_live_peer_survives_pong_deadline(self):
+        """A responsive peer's pongs refresh the deadline: aggressive
+        ping settings must not kill a healthy idle link."""
+        priv_a, _ida, info_a = _identity("la")
+        priv_b, id_b, info_b = _identity("lb")
+        ta = TCPTransport(ping_interval=0.1, pong_timeout=0.35)
+        tb = await _listening(ping_interval=0.1, pong_timeout=0.35)
+        host, port = tb.endpoint().rsplit(":", 1)
+
+        async def server():
+            conn = await tb.accept()
+            await conn.handshake(info_b, priv_b)
+            # serve pongs until the peer sends real data
+            ch, data = await conn.receive_message()
+            return conn, (ch, data)
+
+        stask = asyncio.create_task(server())
+        conn = await ta.dial(NodeAddress(node_id=id_b, host=host, port=int(port)))
+        await conn.handshake(info_a, priv_a)
+        recv = asyncio.create_task(conn.receive_message())
+        # idle for several pong deadlines; pings+pongs keep both alive
+        await asyncio.sleep(1.2)
+        assert not recv.done(), "healthy idle link was torn down"
+        await conn.send_message(0x01, b"still-here")
+        sconn, got = await asyncio.wait_for(stask, 5.0)
+        assert got == (0x01, b"still-here")
+        recv.cancel()
+        await asyncio.gather(recv, return_exceptions=True)
+        await conn.close()
+        await sconn.close()
+        await ta.close()
+        await tb.close()

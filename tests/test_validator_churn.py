@@ -1,0 +1,191 @@
+"""Validator-set churn as a live scenario axis (ISSUE 18 satellite 3):
+the typed `val:` tx format, the PoP-on-update defense at the mempool/app
+boundary (PR 9's rogue-key closure exercised post-genesis for the first
+time), and join/leave/power-shift landing in the consensus validator
+set while the committee keeps committing."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+
+from tendermint_tpu.abci import types as abci
+from tendermint_tpu.abci.kvstore import KVStoreApp
+from tendermint_tpu.consensus import scenarios as sc
+from tendermint_tpu.crypto import bls, ed25519
+
+
+class TestValidatorTxFormat:
+    def _parse(self, tx: bytes) -> abci.ValidatorUpdate:
+        return KVStoreApp._parse_validator_tx(tx)
+
+    def test_legacy_ed25519(self):
+        priv = ed25519.Ed25519PrivKey.generate()
+        pub = priv.pub_key().bytes()
+        vu = self._parse(b"val:" + pub.hex().encode() + b"!7")
+        assert vu == abci.ValidatorUpdate("ed25519", pub, 7)
+
+    def test_typed_ed25519(self):
+        priv = ed25519.Ed25519PrivKey.generate()
+        pub = priv.pub_key().bytes()
+        vu = self._parse(b"val:ed25519:" + pub.hex().encode() + b"!3")
+        assert vu == abci.ValidatorUpdate("ed25519", pub, 3)
+
+    def test_bls_join_with_valid_pop(self):
+        priv = bls.BLSPrivKey(hashlib.sha256(b"churn-ok").digest())
+        pub, pop = priv.pub_key().bytes(), priv.pop_prove()
+        tx = (
+            b"val:bls12381:" + pub.hex().encode() + b"!5!" + pop.hex().encode()
+        )
+        vu = self._parse(tx)
+        assert vu == abci.ValidatorUpdate("bls12381", pub, 5, pop)
+
+    def test_bls_join_without_pop_rejected(self):
+        priv = bls.BLSPrivKey(hashlib.sha256(b"churn-rogue").digest())
+        tx = b"val:bls12381:" + priv.pub_key().bytes().hex().encode() + b"!5"
+        with pytest.raises(ValueError, match="proof of possession"):
+            self._parse(tx)
+
+    def test_bls_join_with_forged_pop_rejected(self):
+        priv = bls.BLSPrivKey(hashlib.sha256(b"churn-forge").digest())
+        other = bls.BLSPrivKey(hashlib.sha256(b"other-key").digest())
+        tx = (
+            b"val:bls12381:"
+            + priv.pub_key().bytes().hex().encode()
+            + b"!5!"
+            + other.pop_prove().hex().encode()
+        )
+        with pytest.raises(ValueError, match="proof of possession"):
+            self._parse(tx)
+
+    def test_bls_leave_needs_no_pop(self):
+        priv = bls.BLSPrivKey(hashlib.sha256(b"churn-leave").digest())
+        tx = b"val:bls12381:" + priv.pub_key().bytes().hex().encode() + b"!0"
+        assert self._parse(tx).power == 0
+
+    def test_bad_inputs_rejected(self):
+        priv = ed25519.Ed25519PrivKey.generate()
+        pub_hex = priv.pub_key().bytes().hex().encode()
+        for tx, pat in (
+            (b"val:" + pub_hex, "val:<hex pubkey>"),
+            (b"val:" + pub_hex + b"!-2", "negative power"),
+            (b"val:zz!1", "bad validator tx encoding"),
+            (b"val:" + b"ab" * 8 + b"!1", "bad validator pubkey"),
+            (b"val:nosuchtype:" + pub_hex + b"!1", "bad validator pubkey"),
+        ):
+            with pytest.raises(ValueError, match=pat):
+                self._parse(tx)
+
+    def test_checktx_and_delivertx_reject_rogue(self):
+        app = KVStoreApp()
+        priv = bls.BLSPrivKey(hashlib.sha256(b"churn-e2e").digest())
+        tx = b"val:bls12381:" + priv.pub_key().bytes().hex().encode() + b"!5"
+        assert app.check_tx(abci.RequestCheckTx(tx)).code == 2
+        app.begin_block(abci.RequestBeginBlock(b"", None, abci.LastCommitInfo(0)))
+        assert app.deliver_tx(abci.RequestDeliverTx(tx)).code == 2
+        assert app.end_block(abci.RequestEndBlock(1)).validator_updates == ()
+
+
+class TestChurnScenarioRegistry:
+    def test_registered_with_all_axes(self):
+        s = sc.SCENARIOS["validator_churn"]
+        actions = [e.action for e in s.events]
+        assert actions == [
+            "churn_join", "churn_rogue_join", "churn_power", "churn_leave",
+        ]
+        assert s.chaos.drop_rate > 0  # churn composes with link chaos
+
+    def test_churn_join_key_is_deterministic(self):
+        a = sc.churn_join_key(7, 100).pub_key().bytes()
+        b = sc.churn_join_key(7, 100).pub_key().bytes()
+        c = sc.churn_join_key(8, 100).pub_key().bytes()
+        assert a == b != c
+
+
+class TestLiveChurn:
+    @pytest.mark.asyncio
+    async def test_churn_lands_in_consensus_validator_set(self):
+        """Join + power-shift + leave flow through the mempool into
+        EndBlock validator updates and land in the CONSENSUS validator
+        set (not just the app's mirror) while the committee keeps
+        committing; the rogue bls join bounces off every mempool."""
+        from tendermint_tpu.consensus.harness import GENESIS_TIME_NS, MS
+        from tendermint_tpu.consensus.routernet import RouterNet
+        from tendermint_tpu.consensus.scenarios import (
+            Event,
+            _churn_tx,
+            _inject_tx,
+            churn_join_key,
+        )
+        from tendermint_tpu.libs.clock import ManualClock
+
+        net = RouterNet(
+            4, base_clock=ManualClock(GENESIS_TIME_NS - 500 * MS), topo_seed=7
+        )
+        seed = 7
+        try:
+            await asyncio.wait_for(net.start(), 60.0)
+
+            async def wait_set(pred, what, timeout=30.0):
+                async def _poll():
+                    while True:
+                        vals = net.nodes[0].cs.rs.validators
+                        by_addr = {
+                            v.address: v.voting_power for v in vals.validators
+                        }
+                        if pred(by_addr):
+                            return by_addr
+                        await asyncio.sleep(0.05)
+
+                try:
+                    return await asyncio.wait_for(_poll(), timeout)
+                except asyncio.TimeoutError:
+                    raise AssertionError(f"churn never applied: {what}")
+
+            join_addr = churn_join_key(seed, 100).pub_key().address()
+            v1_addr = net.keys[1].pub_key().address()
+            v3_addr = net.keys[3].pub_key().address()
+
+            tx, rej = _churn_tx(Event(0, "churn_join", node=100), net, seed)
+            assert not rej
+            await _inject_tx(net, tx, expect_reject=False)
+            await wait_set(lambda m: m.get(join_addr) == 1, "join")
+
+            # the rogue bls12381 join must bounce off EVERY mempool —
+            # _inject_tx raises if any node accepts it
+            tx, rej = _churn_tx(Event(0, "churn_rogue_join", node=5), net, seed)
+            assert rej
+            await _inject_tx(net, tx, expect_reject=True)
+
+            tx, _ = _churn_tx(Event(0, "churn_power", node=1, power=3), net, seed)
+            await _inject_tx(net, tx, expect_reject=False)
+            await wait_set(lambda m: m.get(v1_addr) == 3, "power shift")
+
+            tx, _ = _churn_tx(Event(0, "churn_leave", node=3), net, seed)
+            await _inject_tx(net, tx, expect_reject=False)
+            left = await wait_set(lambda m: v3_addr not in m, "leave")
+            assert left.get(join_addr) == 1 and left.get(v1_addr) == 3
+
+            # the committee (including the now non-validator node 3)
+            # keeps committing after the full churn sequence
+            h = min(net.heights())
+            await asyncio.wait_for(net.wait_for_height(h + 1, 30.0), 30.0)
+        finally:
+            await net.stop()
+
+    @pytest.mark.asyncio
+    async def test_validator_churn_scenario_smoke(self):
+        """The registered scenario runs end to end under link chaos with
+        a clean audit — the tier-1 smoke the slow sweeps scale up."""
+        res = await sc.run_scenario(
+            "validator_churn", n_vals=4, target_height=3, seed=3,
+            timeout_s=90.0, stall_s=30.0,
+        )
+        assert res.ok, res.as_dict()
+        assert res.events_applied == [
+            "churn_join", "churn_rogue_join", "churn_power", "churn_leave",
+        ]
+        assert not res.error, res.error
+        assert res.audit and res.audit["ok"], res.audit
