@@ -667,6 +667,191 @@ def bench_chaos_soak(sizes: tuple = (4, 50)) -> dict:
     return out
 
 
+def bench_wiregen(soak_vals: int = 50) -> dict:
+    """wiregen config: the compiled hot codec A/B'd against the
+    interpreted codec it was generated from. Two halves:
+
+      * per-family encode/decode frames/s, paired-interleaved: each rep
+        times interpreted then generated back-to-back in the same
+        window and the best rep wins, so shared-host steal lands on
+        both sides instead of skewing the ratio;
+      * chaos_soak blocks/s with the codec flipped — the same seeded
+        baseline scenario at `soak_vals` validators, run once per
+        codec, nets built AFTER the `use_wiregen` flip so every node
+        dispatches through the codec under test.
+
+    Pure host work; the device is not on this path."""
+    import asyncio
+
+    import tendermint_tpu.types.block as blk
+    from tendermint_tpu.consensus import messages as cm
+    from tendermint_tpu.consensus import wire_gen as wg
+    from tendermint_tpu.crypto.merkle import Proof
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+    from tendermint_tpu.types.keys import BLOCK_PART_SIZE, SignedMsgType
+    from tendermint_tpu.types.part_set import Part
+    from tendermint_tpu.types.vote import Vote
+
+    bid = BlockID(b"\xab" * 32, PartSetHeader(4, b"\xcd" * 32))
+
+    def _vote(i: int) -> Vote:
+        return Vote(
+            type=SignedMsgType.PREVOTE,
+            height=1000 + i,
+            round=2,
+            block_id=bid,
+            timestamp_ns=1_700_000_000_000_000_000 + i,
+            validator_address=bytes([i % 256]) * 20,
+            validator_index=i,
+            signature=bytes([i % 256]) * 64,
+        )
+
+    def _soak_part() -> cm.BlockPartMessage:
+        # the shape chaos_soak actually gossips: a single-part block
+        # (50-sig commit + a few txs), whose one-leaf proof has 0 aunts
+        sigs = tuple(
+            blk.CommitSig(
+                flag=blk.BLOCK_ID_FLAG_COMMIT,
+                validator_address=bytes([i % 256]) * 20,
+                timestamp_ns=1_700_000_000_000_000_000 + i,
+                signature=bytes([i % 256]) * 64,
+            )
+            for i in range(50)
+        )
+        hdr = blk.Header(
+            chain_id="soak",
+            height=3,
+            time_ns=1_700_000_000_000_000_000,
+            last_block_id=bid,
+            proposer_address=b"\x01" * 20,
+            validators_hash=b"\x02" * 32,
+            next_validators_hash=b"\x02" * 32,
+            app_hash=b"\x03" * 32,
+        )
+        block = blk.Block(
+            header=hdr,
+            txs=(b"tx-aaaa", b"tx-bbbb"),
+            last_commit=blk.Commit(
+                height=2, round=0, block_id=bid, signatures=sigs
+            ),
+        )
+        return cm.BlockPartMessage(3, 0, block.make_part_set().parts[0])
+
+    families = {
+        "Vote": (cm.VoteMessage(_vote(7)), 3000),
+        "VoteBatch[64]": (
+            cm.VoteBatchMessage(tuple(_vote(i) for i in range(64))),
+            200,
+        ),
+        "HasVote": (cm.HasVoteMessage(1000, 2, SignedMsgType.PREVOTE, 7), 5000),
+        "BlockPart[soak]": (_soak_part(), 1000),
+        "BlockPart[64KiB]": (
+            cm.BlockPartMessage(
+                9,
+                1,
+                Part(
+                    3,
+                    bytes(range(256)) * (BLOCK_PART_SIZE // 256),
+                    Proof(16, 3, b"\x11" * 32, tuple(b"\x22" * 32 for _ in range(4))),
+                ),
+            ),
+            400,
+        ),
+    }
+
+    def _paired_best(fa, fb, arg, iters, reps=12):
+        best_a = best_b = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fa(arg)
+            t1 = time.perf_counter()
+            for _ in range(iters):
+                fb(arg)
+            t2 = time.perf_counter()
+            best_a = min(best_a, (t1 - t0) / iters)
+            best_b = min(best_b, (t2 - t1) / iters)
+        return best_a, best_b
+
+    # warm the interpreter/caches before the first paired window
+    warm = cm.encode_message_py(families["BlockPart[soak]"][0])
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < 0.5:
+        cm.decode_message_py(warm)
+        wg.decode_message(warm)
+
+    out: dict = {"families": {}}
+    for name, (msg, iters) in families.items():
+        frame = cm.encode_message_py(msg)
+        assert frame == wg.encode_message(msg), f"{name}: A/B bytes differ"
+        ei, eg = _paired_best(
+            cm.encode_message_py, wg.encode_message, msg, iters
+        )
+        di, dg = _paired_best(
+            cm.decode_message_py, wg.decode_message, frame, iters
+        )
+        row = {
+            "frame_bytes": len(frame),
+            "enc_interp_per_s": round(1.0 / ei, 1),
+            "enc_gen_per_s": round(1.0 / eg, 1),
+            "enc_speedup": round(ei / eg, 2),
+            "dec_interp_per_s": round(1.0 / di, 1),
+            "dec_gen_per_s": round(1.0 / dg, 1),
+            "dec_speedup": round(di / dg, 2),
+        }
+        out["families"][name] = row
+        log(
+            f"wiregen {name:<16} enc {row['enc_speedup']:>5.2f}x "
+            f"dec {row['dec_speedup']:>5.2f}x "
+            f"({row['dec_gen_per_s']:,.0f} dec/s gen)"
+        )
+
+    # -- chaos_soak blocks/s with the codec flipped -----------------------
+    if os.environ.get("TMTPU_BENCH_WIREGEN_SOAK") != "0":
+        from tendermint_tpu.consensus import scenarios as sc
+
+        seed = int(os.environ.get("TMTPU_BENCH_SOAK_SEED", "7") or 7)
+        was = cm.wiregen_active()
+        soak: dict = {"n_vals": soak_vals, "seed": seed, "scenario": "baseline"}
+        try:
+            for label, enabled in (("interpreted", False), ("generated", True)):
+                cm.use_wiregen(enabled)
+
+                async def one(_n=soak_vals):
+                    return await sc.run_scenario(
+                        "baseline",
+                        n_vals=_n,
+                        target_height=2,
+                        seed=seed,
+                        timeout_s=300.0,
+                        stall_s=90.0,
+                        time_scale=4.0,
+                        degree=8,
+                    )
+
+                t0 = time.perf_counter()
+                try:
+                    res = asyncio.run(
+                        asyncio.wait_for(one(), 360.0)
+                    ).as_dict()
+                except Exception as e:  # noqa: BLE001 — structured outcome
+                    res = {"outcome": f"error: {e!r}"[:200]}
+                res["wall_s"] = round(time.perf_counter() - t0, 2)
+                soak[label] = res
+                log(
+                    f"wiregen soak[{label}] {res.get('outcome', '?')} "
+                    f"{res.get('blocks_per_s', 0)} blk/s "
+                    f"wall={res['wall_s']}s"
+                )
+            bi = soak.get("interpreted", {}).get("blocks_per_s") or 0
+            bg = soak.get("generated", {}).get("blocks_per_s") or 0
+            soak["soak_speedup"] = round(bg / bi, 2) if bi else None
+        finally:
+            cm.use_wiregen(was)
+        out["chaos_soak_ab"] = soak
+    return out
+
+
 def bench_byz_soak(sizes: tuple = (4, 50)) -> dict:
     """byz_soak config: Byzantine strategies over real routers measured
     per round — blocks/s under each traitor strategy, time-to-evidence-
@@ -2349,6 +2534,17 @@ def main() -> None:
             extra["chaos_soak"] = bench_chaos_soak(soak_vals)
         except Exception as e:  # noqa: BLE001
             log(f"chaos-soak bench failed: {e!r}")
+    # wiregen runs on BOTH backends, BOUNDED: the compiled hot codec
+    # (consensus/wire_gen.py, regenerated from the wire-schema lockfile
+    # by scripts/wiregen) A/B'd against the interpreted codec —
+    # per-family encode/decode frames/s plus chaos_soak blocks/s with
+    # the codec flipped. Pure host work; the device is not on this path.
+    if os.environ.get("TMTPU_BENCH_WIREGEN") != "0":
+        try:
+            wg_vals = int(os.environ.get("TMTPU_BENCH_WIREGEN_VALS", "50"))
+            extra["wiregen"] = bench_wiregen(wg_vals)
+        except Exception as e:  # noqa: BLE001
+            log(f"wiregen bench failed: {e!r}")
     # byz_soak runs on BOTH backends, BOUNDED: Byzantine strategies over
     # real routers — blocks/s per strategy, time-to-evidence-commit,
     # and the cross-node safety auditor's verdict at 4 and 50

@@ -7,6 +7,7 @@ or TimeoutInfo for timer ticks — reference wal.go WALMessage)."""
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 from ..libs import protoenc as pe
@@ -209,7 +210,7 @@ def _decode_bits(data: bytes) -> BitArray:
     return BitArray.from_bytes(n, raw)
 
 
-def encode_message(msg: Message) -> bytes:
+def encode_message_py(msg: Message) -> bytes:
     if isinstance(msg, NewRoundStepMessage):
         body = (
             pe.varint_field(1, msg.height)
@@ -277,7 +278,7 @@ def encode_message(msg: Message) -> bytes:
     raise TypeError(f"unknown consensus message {type(msg)}")
 
 
-def decode_message(data: bytes) -> Message:
+def decode_message_py(data: bytes) -> Message:
     r = pe.Reader(data)
     f, wt = r.read_tag()
     body = r.read_bytes()
@@ -416,6 +417,60 @@ def decode_message(data: bytes) -> Message:
             return VoteSetMaj23Message(height, round_, type_, bid)
         return VoteSetBitsMessage(height, round_, type_, bid, bits)
     raise ValueError(f"unknown consensus message tag {f}")
+
+
+# -- wiregen dispatch -----------------------------------------------------
+# `encode_message` / `decode_message` are rebindable module globals: the
+# interpreted codec above by default, the generated fast path
+# (consensus/wire_gen.py, built by scripts/wiregen) once it imports.
+# TMTPU_WIREGEN=0 is the kill switch; `use_wiregen` flips at runtime.
+
+encode_message = encode_message_py
+decode_message = decode_message_py
+
+_WIREGEN_WANTED = os.environ.get("TMTPU_WIREGEN", "1") != "0"
+
+
+def _adopt_generated(enc, dec) -> None:
+    """Import tail of wire_gen hands over its entry points; honored only
+    while the kill switch is open."""
+    global encode_message, decode_message
+    if _WIREGEN_WANTED:
+        encode_message = enc
+        decode_message = dec
+
+
+def use_wiregen(enabled: bool) -> bool:
+    """Flip the active codec. Returns True iff the generated codec is
+    live after the call (False when disabled or wire_gen cannot load)."""
+    global _WIREGEN_WANTED, encode_message, decode_message
+    _WIREGEN_WANTED = bool(enabled)
+    if not enabled:
+        encode_message = encode_message_py
+        decode_message = decode_message_py
+        return False
+    try:
+        from . import wire_gen
+
+        enc = wire_gen.encode_message
+        dec = wire_gen.decode_message
+    except Exception:
+        # missing/broken generated module, or a circular import while
+        # this module is still loading — wire_gen's import tail calls
+        # _adopt_generated once it finishes, so leave _WIREGEN_WANTED
+        # set and fall back to the interpreted codec for now.
+        return False
+    encode_message = enc
+    decode_message = dec
+    return True
+
+
+def wiregen_active() -> bool:
+    """True when gossip frames flow through the generated codec."""
+    return encode_message is not encode_message_py
+
+
+use_wiregen(_WIREGEN_WANTED)
 
 
 # -- WAL message wrapping -------------------------------------------------

@@ -24,6 +24,19 @@ Suppression is explicit and auditable, never silent:
     not suppress and is itself reported as a `bad-pragma` finding.
     ``allow[*]`` suppresses every rule (use sparingly).
 
+  * file-scope pragma::
+
+        # tmtlint: allow-file[rule-id, ...] -- why this whole file is exempt
+
+    Exempts the ENTIRE file from the named PER-FILE rules
+    (``allow-file[*]`` for all of them) — the machine-written header of
+    generated modules uses this so generated code never needs
+    hand-maintained allowlist growth. Project rules (tree-wide
+    analyzers like wire-schema or wiregen-drift) deliberately ignore
+    file pragmas: a generated file must not be able to exempt itself
+    from the drift check that guards it. The same mandatory
+    ``-- reason`` / known-rule-id validation applies (`bad-pragma`).
+
   * checked-in allowlist (``allowlist.json`` next to this module):
     per-rule path prefixes with reasons, for whole-file exemptions
     (e.g. crypto/ backends ARE the verify chokepoint).
@@ -55,6 +68,10 @@ BAD_PRAGMA = "bad-pragma"
 
 _PRAGMA_RE = re.compile(
     r"#\s*tmtlint:\s*allow\[([^\]]*)\]\s*(?:--\s*(\S.*))?"
+)
+
+_FILE_PRAGMA_RE = re.compile(
+    r"#\s*tmtlint:\s*allow-file\[([^\]]*)\]\s*(?:--\s*(\S.*))?"
 )
 
 
@@ -193,6 +210,7 @@ class FileContext:
 
     _pragma_table: dict[int, list[Pragma]] | None = None
     _pragma_raw: list[Pragma] | None = None
+    _file_pragma_raw: list[Pragma] | None = None
 
     @property
     def pragmas(self) -> dict[int, list[Pragma]]:
@@ -252,6 +270,35 @@ class FileContext:
             for p in self.pragmas.get(finding.line, ())
         )
 
+    @property
+    def file_pragmas(self) -> list[Pragma]:
+        """File-scope ``allow-file[...]`` pragmas, anywhere in the file
+        (by convention the machine-written header of generated code)."""
+        if self._file_pragma_raw is None:
+            raw: list[Pragma] = []
+            for line, col, text in self._comments():
+                m = _FILE_PRAGMA_RE.search(text)
+                if not m:
+                    continue
+                rules = frozenset(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                reason = m.group(2).strip() if m.group(2) else None
+                only = not self.lines[line - 1][:col].strip()
+                raw.append(Pragma(line, rules, reason, only))
+            self._file_pragma_raw = raw
+        return self._file_pragma_raw
+
+    def file_suppressed(self, rule_id: str) -> bool:
+        """True when a reasoned file-scope pragma exempts `rule_id` for
+        this whole file. Consulted for PER-FILE rules only — project
+        rules (drift checks and other tree-wide invariants) never honor
+        file pragmas."""
+        return any(
+            p.reason is not None and ("*" in p.rules or rule_id in p.rules)
+            for p in self.file_pragmas
+        )
+
     def pragma_errors(
         self, known_rules: frozenset[str] | set[str] | None = None
     ) -> list[Finding]:
@@ -262,7 +309,7 @@ class FileContext:
         fires in CI; make the typo itself fail."""
         self.pragmas  # ensure _pragma_raw is populated
         out = []
-        for p in self._pragma_raw:
+        for p in list(self._pragma_raw) + self.file_pragmas:
             if p.reason is None:
                 out.append(
                     Finding(
@@ -828,6 +875,8 @@ def _check_file(
         if not rule.applies_to(ctx.rel, profile):
             continue
         if allowlist.exempt(rule.id, ctx.rel):
+            continue
+        if ctx.file_suppressed(rule.id):
             continue
         for f in rule.check(ctx):
             if not ctx.suppressed(f):

@@ -14,6 +14,7 @@ from . import (
     project_rules,
     trace_rules,
     wire_rules,
+    wiregen_rules,
 )
 
 ALL_RULES = (
@@ -25,6 +26,7 @@ ALL_RULES = (
     *project_rules.RULES,
     *trace_rules.RULES,
     *wire_rules.RULES,
+    *wiregen_rules.RULES,
 )
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

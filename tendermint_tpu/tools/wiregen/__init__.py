@@ -1,0 +1,21 @@
+"""wiregen — compile the hot consensus codec from the wire-schema lockfile.
+
+PR 15's static analysis locked every protoenc frame layout into
+`tools/lint/wire_schema.lock.json`; this package consumes that lockfile
+(plus the extractor's AST-level frame info, for freshness cross-checks)
+and emits `tendermint_tpu/consensus/wire_gen.py`: flat, allocation-light
+encoders/decoders for the top gossip frame families. Generation is a
+pure function of the lockfile + the spec tables in `generator.py`, so
+the output is byte-deterministic — the `wiregen-drift` tmtlint rule
+re-runs it in memory and fails the gate if the checked-in module ever
+diverges. `scripts/wiregen` is the CLI (`--check` / `--update`).
+"""
+
+from .generator import (  # noqa: F401
+    GENERATED_REL,
+    LOCK_FILES,
+    SpecMismatch,
+    generate,
+    load_lock,
+    schema_hash,
+)

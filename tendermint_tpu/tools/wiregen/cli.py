@@ -1,0 +1,102 @@
+"""Command line for wiregen (see scripts/wiregen).
+
+`--check` (the default, and what the tier-1 lint gate shells out to)
+exits non-zero when the checked-in generated module is missing, stale,
+or the lockfile/spec disagree; `--update` rewrites it in place. Output
+is byte-deterministic: the same lockfile always renders the identical
+module, so `--update` twice in a row is a no-op.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .generator import (
+    GENERATED_REL,
+    REPO,
+    SpecMismatch,
+    check,
+    generate,
+    load_lock,
+    schema_hash,
+    update,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="wiregen",
+        description="compile the hot consensus codec from the "
+        "wire-schema lockfile",
+    )
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument(
+        "--check",
+        action="store_true",
+        help="verify the checked-in generated module is byte-identical "
+        "to a fresh regen (default)",
+    )
+    mode.add_argument(
+        "--update",
+        action="store_true",
+        help=f"rewrite {GENERATED_REL} from the lockfile",
+    )
+    mode.add_argument(
+        "--stdout",
+        action="store_true",
+        help="render the generated module to stdout without touching "
+        "the tree",
+    )
+    ap.add_argument(
+        "--lock",
+        metavar="PATH",
+        default=None,
+        help="lockfile to compile from (default: the blessed one)",
+    )
+    args = ap.parse_args(argv)
+
+    lock = None
+    if args.lock is not None:
+        try:
+            lock = load_lock(args.lock)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"wiregen: cannot load {args.lock}: {exc}", file=sys.stderr)
+            return 1
+
+    if args.stdout:
+        try:
+            if lock is None:
+                lock = load_lock()
+            sys.stdout.write(generate(lock))
+        except (OSError, json.JSONDecodeError, SpecMismatch) as exc:
+            print(f"wiregen: {exc}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.update:
+        try:
+            changed = update(REPO, lock)
+        except (OSError, json.JSONDecodeError) as exc:
+            print(f"wiregen: {exc}", file=sys.stderr)
+            return 1
+        except SpecMismatch as exc:
+            print(f"wiregen: spec mismatch: {exc}", file=sys.stderr)
+            return 1
+        lock = lock if lock is not None else load_lock()
+        state = "regenerated" if changed else "already fresh"
+        print(f"wiregen: {GENERATED_REL} {state} ({schema_hash(lock)})")
+        return 0
+
+    problems = check(REPO, lock)
+    if problems:
+        for p in problems:
+            print(f"wiregen: {p}", file=sys.stderr)
+        return 1
+    print(f"wiregen: {GENERATED_REL} is fresh")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

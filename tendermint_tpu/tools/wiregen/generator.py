@@ -1,0 +1,340 @@
+"""wiregen core: spec tables, lockfile validation, schema hash, generation.
+
+The generated module is a pure function of two inputs:
+
+  * the blessed wire-schema lockfile (PR 15's `--update-lock` artifact) —
+    hashed into the generated header, so ANY re-bless of a compiled
+    frame file forces a visible regen;
+  * the spec tables below, which name the exact frame layouts the
+    compiler understands. `validate_lock` cross-checks every table
+    against the lockfile entry (set equality, both directions): if a
+    field is renumbered/retyped or a decode bound dropped, generation
+    refuses with `SpecMismatch` instead of silently emitting a codec
+    that disagrees with the blessed schema.
+
+`render` (in `_emit.py`) turns the tables into
+`tendermint_tpu/consensus/wire_gen.py`; byte-determinism is by
+construction (no timestamps, no environment, sorted iteration only).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+)
+LOCKFILE_REL = "tendermint_tpu/tools/lint/wire_schema.lock.json"
+GENERATED_REL = "tendermint_tpu/consensus/wire_gen.py"
+
+#: the frame files the generated codec specializes — their complete
+#: lockfile entries feed the schema hash, so a blessed wire change in
+#: any of them (even one wiregen does not compile) forces a regen.
+LOCK_FILES = (
+    "tendermint_tpu/consensus/messages.py",
+    "tendermint_tpu/crypto/merkle.py",
+    "tendermint_tpu/types/block.py",
+    "tendermint_tpu/types/canonical.py",
+    "tendermint_tpu/types/part_set.py",
+    "tendermint_tpu/types/vote.py",
+)
+
+
+class SpecMismatch(Exception):
+    """The lockfile and wiregen's spec tables disagree — the tree's wire
+    surface moved and the compiler was not taught the new layout."""
+
+
+# -- frame layout spec tables -------------------------------------------
+# (field_number, wire_kind) in encode source order. These mirror the
+# interpreted codec field-for-field; validate_lock pins them to the
+# lockfile so they cannot rot silently.
+
+F_TS = ((1, "varint"), (2, "varint"))  # canonical.encode_timestamp
+F_PSH = ((1, "varint"), (2, "bytes"))  # PartSetHeader
+F_BLOCKID = ((1, "bytes"), (2, "message"))
+F_PROOF = ((1, "varint"), (2, "varint"), (3, "bytes"), (4, "message"))
+F_PART = ((1, "varint"), (2, "bytes"), (3, "message"))
+F_CSIG = ((1, "varint"), (2, "bytes"), (3, "message"), (4, "bytes"))
+F_COMMIT = (
+    (1, "sfixed64"),
+    (2, "sfixed64"),
+    (3, "message"),
+    (4, "message"),
+    (5, "bytes"),
+)
+F_VOTE = (
+    (1, "varint"),
+    (2, "sfixed64"),
+    (3, "sfixed64"),
+    (4, "message"),
+    (5, "message"),
+    (6, "bytes"),
+    (7, "varint"),
+    (8, "bytes"),
+)
+F_PROPOSAL = (
+    (1, "sfixed64"),
+    (2, "sfixed64"),
+    (3, "sfixed64"),
+    (4, "message"),
+    (5, "message"),
+    (6, "bytes"),
+)
+F_BITS = ((1, "varint"), (2, "bytes"))  # messages._encode_bits
+F_HAS_VOTE = ((1, "varint"), (2, "varint"), (3, "varint"), (4, "varint"))
+F_NRS = (
+    (1, "varint"),
+    (2, "varint"),
+    (3, "varint"),
+    (4, "varint"),
+    (5, "varint"),
+)
+F_NVB = (
+    (1, "varint"),
+    (2, "varint"),
+    (3, "message"),
+    (4, "message"),
+    (5, "varint"),
+)
+F_POL = ((1, "varint"), (2, "varint"), (3, "message"))
+F_BPART = ((1, "varint"), (2, "varint"), (3, "message"))
+F_VB = ((1, "bytes"),)
+F_HVB = ((1, "message"),)
+F_VSM = ((1, "varint"), (2, "varint"), (3, "varint"), (4, "message"))
+F_VSB = (
+    (1, "varint"),
+    (2, "varint"),
+    (3, "varint"),
+    (4, "message"),
+    (5, "message"),
+)
+
+#: consensus envelope: (tag constant name in messages.py, value)
+ENVELOPE = (
+    ("T_NEW_ROUND_STEP", 1),
+    ("T_NEW_VALID_BLOCK", 2),
+    ("T_PROPOSAL", 3),
+    ("T_PROPOSAL_POL", 4),
+    ("T_BLOCK_PART", 5),
+    ("T_VOTE", 6),
+    ("T_HAS_VOTE", 7),
+    ("T_VOTE_SET_MAJ23", 8),
+    ("T_VOTE_SET_BITS", 9),
+    ("T_VOTE_BATCH", 10),
+    ("T_HAS_VOTE_BATCH", 11),
+)
+
+
+def _enc_set(*fams) -> set[str]:
+    return {f"{n}:{k}" for fam in fams for n, k in fam}
+
+
+def _dec_set(*fams) -> set[str]:
+    return {str(n) for fam in fams for n, _ in fam}
+
+
+#: per-file, per-function expected lockfile entries (as sets) plus the
+#: decode-bound NAMES that must be in force. Bound VALUES are not
+#: pinned here: the generated code reads them from the owning
+#: interpreted module at call time, so a retuned bound needs only a
+#: regen (the schema hash moves), not a spec edit.
+EXPECTED: dict[str, dict] = {
+    "tendermint_tpu/types/canonical.py": {
+        "encoders": {"encode_timestamp": _enc_set(F_TS)},
+        "decoders": {},
+        "bounds": set(),
+    },
+    "tendermint_tpu/types/vote.py": {
+        "encoders": {
+            "Vote.encode": _enc_set(F_VOTE),
+            "Proposal.encode": _enc_set(F_PROPOSAL),
+        },
+        "decoders": {
+            "Vote.decode": _dec_set(F_VOTE),
+            "Proposal.decode": _dec_set(F_PROPOSAL),
+        },
+        "bounds": set(),
+    },
+    "tendermint_tpu/types/block.py": {
+        "encoders": {
+            "PartSetHeader.encode": _enc_set(F_PSH),
+            "BlockID.encode": _enc_set(F_BLOCKID),
+            "CommitSig.encode": _enc_set(F_CSIG),
+            "Commit.encode": _enc_set(F_COMMIT),
+        },
+        "decoders": {
+            "PartSetHeader.decode": _dec_set(F_PSH),
+            "BlockID.decode": _dec_set(F_BLOCKID),
+            "CommitSig.decode": _dec_set(F_CSIG),
+            "Commit.decode": _dec_set(F_COMMIT),
+            "_decode_timestamp": _dec_set(F_TS),
+        },
+        "bounds": {"MAX_WIRE_COMMIT_SIGS"},
+    },
+    "tendermint_tpu/types/part_set.py": {
+        "encoders": {"Part.encode": _enc_set(F_PART)},
+        "decoders": {"Part.decode": _dec_set(F_PART)},
+        "bounds": set(),
+    },
+    "tendermint_tpu/crypto/merkle.py": {
+        "encoders": {"Proof.encode": _enc_set(F_PROOF)},
+        "decoders": {"Proof.decode": _dec_set(F_PROOF)},
+        "bounds": {"MAX_PROOF_AUNTS"},
+    },
+    "tendermint_tpu/consensus/messages.py": {
+        "encoders": {
+            "_encode_bits": _enc_set(F_BITS),
+            "_encode_has_vote_body": _enc_set(F_HAS_VOTE),
+            "encode_message_py": _enc_set(
+                F_NRS, F_NVB, F_PSH, F_POL, F_BPART, F_VB, F_HVB, F_VSM, F_VSB
+            )
+            | {f"{name}={num}:message" for name, num in ENVELOPE},
+        },
+        "decoders": {
+            "_decode_bits": _dec_set(F_BITS),
+            "_decode_has_vote_body": _dec_set(F_HAS_VOTE),
+            "decode_message_py": _dec_set(
+                F_NRS, F_NVB, F_PSH, F_POL, F_BPART, F_VB, F_HVB, F_VSM, F_VSB
+            )
+            | {f"{name}={num}" for name, num in ENVELOPE},
+        },
+        "bounds": {"MAX_BATCH_VOTES", "MAX_WIRE_BITS", "MAX_WIRE_INDEX"},
+    },
+}
+
+
+# -- lockfile access ----------------------------------------------------
+
+
+def load_lock(path: str | None = None) -> dict:
+    if path is None:
+        path = os.path.join(REPO, LOCKFILE_REL)
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def schema_subset(lock: dict) -> dict:
+    """The lockfile slice the generated codec depends on."""
+    files = lock.get("files", {})
+    return {rel: files.get(rel) for rel in LOCK_FILES}
+
+
+def schema_hash(lock: dict) -> str:
+    blob = json.dumps(
+        schema_subset(lock), separators=(",", ":"), sort_keys=True
+    )
+    return "sha256:" + hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def validate_lock(lock: dict) -> list[str]:
+    """Cross-check the spec tables against the blessed lockfile. Empty
+    list means every compiled frame layout matches."""
+    problems: list[str] = []
+    files = lock.get("files", {})
+    for rel in sorted(EXPECTED):
+        entry = files.get(rel)
+        if entry is None:
+            problems.append(
+                f"{rel}: no lockfile entry, but wiregen compiles this "
+                "file's frames — run scripts/tmtlint --update-lock first"
+            )
+            continue
+        exp = EXPECTED[rel]
+        for section in ("encoders", "decoders"):
+            locked = entry.get(section, {})
+            for fn in sorted(exp[section]):
+                want = exp[section][fn]
+                got = locked.get(fn)
+                if got is None:
+                    problems.append(
+                        f"{rel}: locked {section[:-1]} `{fn}` is missing "
+                        "— wiregen's spec tables are out of date with "
+                        "the tree"
+                    )
+                    continue
+                gotset = set(got)
+                if gotset != want:
+                    detail = []
+                    missing = sorted(want - gotset)
+                    extra = sorted(gotset - want)
+                    if missing:
+                        detail.append(f"spec expects {missing}")
+                    if extra:
+                        detail.append(f"lockfile adds {extra}")
+                    problems.append(
+                        f"{rel}: `{fn}` frame layout disagrees with "
+                        f"wiregen's spec ({'; '.join(detail)}) — teach "
+                        "tools/wiregen/generator.py the new layout "
+                        "before regenerating"
+                    )
+        bound_names = {b.split("=", 1)[0] for b in entry.get("bounds", [])}
+        for name in sorted(exp["bounds"]):
+            if name not in bound_names:
+                problems.append(
+                    f"{rel}: decode bound {name} is gone from the "
+                    "lockfile entry — the generated codec carries it; "
+                    "restore the clamp or update the spec"
+                )
+    return problems
+
+
+def generate(lock: dict) -> str:
+    """Validate the lockfile against the spec and render the module."""
+    problems = validate_lock(lock)
+    if problems:
+        raise SpecMismatch("; ".join(problems))
+    from ._emit import render
+
+    return render(schema_hash(lock))
+
+
+# -- CLI/check helpers --------------------------------------------------
+
+
+def generated_path(repo: str = REPO) -> str:
+    return os.path.join(repo, GENERATED_REL)
+
+
+def check(repo: str = REPO, lock: dict | None = None) -> list[str]:
+    """Problems that should fail a gate; empty means fresh."""
+    if lock is None:
+        try:
+            lock = load_lock(os.path.join(repo, LOCKFILE_REL))
+        except (OSError, json.JSONDecodeError) as exc:
+            return [f"cannot load {LOCKFILE_REL}: {exc}"]
+    try:
+        fresh = generate(lock)
+    except SpecMismatch as exc:
+        return [str(exc)]
+    try:
+        with open(generated_path(repo), encoding="utf-8") as f:
+            current = f.read()
+    except OSError:
+        return [f"{GENERATED_REL} is missing — run scripts/wiregen --update"]
+    if current != fresh:
+        return [
+            f"{GENERATED_REL} is stale (not byte-identical to a fresh "
+            "regen from the lockfile) — run scripts/wiregen --update"
+        ]
+    return []
+
+
+def update(repo: str = REPO, lock: dict | None = None) -> bool:
+    """Write a fresh generated module. Returns True when bytes changed."""
+    if lock is None:
+        lock = load_lock(os.path.join(repo, LOCKFILE_REL))
+    fresh = generate(lock)
+    path = generated_path(repo)
+    try:
+        with open(path, encoding="utf-8") as f:
+            current = f.read()
+    except OSError:
+        current = None
+    if current == fresh:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(fresh)
+    return True

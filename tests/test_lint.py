@@ -1192,7 +1192,12 @@ def test_repo_tree_is_clean_and_fast():
     # rounds can diff lint drift across PRs
     assert set(payload["per_rule"]) == set(payload["rules"])
     assert all(v == 0 for v in payload["per_rule"].values())
-    for required in ("transitive-blocking", "wire-schema", "wire-bounds"):
+    for required in (
+        "transitive-blocking",
+        "wire-schema",
+        "wire-bounds",
+        "wiregen-drift",
+    ):
         assert required in payload["per_rule"]
 
 

@@ -20,6 +20,38 @@ import pytest
 from tendermint_tpu.libs import protoenc as pe
 
 
+@pytest.fixture(params=["interpreted", "generated"], autouse=True)
+def codec(request, monkeypatch):
+    """Run every bomb in this module against BOTH codecs.
+
+    The wiregen-generated codec carries the same decode bounds as the
+    interpreted one (read from the owning module at call time, so the
+    monkeypatched-down bounds below govern both). For the frame
+    families wiregen compiles — merkle proofs, commits, and the
+    consensus message envelope — the generated decoders are swapped in;
+    families wiregen does not compile run their (interpreted) decode
+    unchanged under both params.
+    """
+    import tendermint_tpu.consensus.messages as cm
+
+    was_generated = cm.wiregen_active()
+    if request.param == "generated":
+        if not cm.use_wiregen(True):
+            pytest.skip("generated codec unavailable")
+        from tendermint_tpu.consensus import wire_gen as wg
+        from tendermint_tpu.crypto import merkle
+        from tendermint_tpu.types import block as b
+
+        monkeypatch.setattr(
+            merkle.Proof, "decode", staticmethod(wg.decode_proof)
+        )
+        monkeypatch.setattr(b.Commit, "decode", staticmethod(wg.decode_commit))
+    else:
+        cm.use_wiregen(False)
+    yield request.param
+    cm.use_wiregen(was_generated)
+
+
 # ---------------------------------------------------------------------------
 # mempool gossip frames
 
