@@ -852,6 +852,205 @@ def bench_wiregen(soak_vals: int = 50) -> dict:
     return out
 
 
+def bench_merkle(soak_vals: int = 50) -> dict:
+    """merkle config: the HashHub's level-order batched tree builder
+    A/B'd against the scalar recursive reference. Three halves:
+
+      * leaves/s at 64 / 1k / 16k leaves (250-byte leaves — the tx
+        shape), paired-interleaved best-of-reps like extra.wiregen:
+        scalar recursive vs batched level-order (CPU), plus the device
+        bucket route when TMTPU_HASH_TPU=1;
+      * block-hash/s over a realistic header (14 cdc-encoded fields +
+        50-sig commit root), memoization stripped per rep so the tree
+        build itself is what's timed;
+      * chaos_soak blocks/s with `use_hashhub` flipped — the same
+        seeded baseline scenario at `soak_vals` validators once per
+        builder.
+
+    The CPU half IS the acceptance number (≥1.5× at 1024 leaves):
+    batching amortizes Python frames the way VoteBatch amortized
+    envelopes; the device half only engages when explicitly enabled."""
+    import asyncio
+    from dataclasses import replace as _dc_replace
+
+    import tendermint_tpu.types.block as blk
+    from tendermint_tpu.crypto import hash_hub, merkle
+    from tendermint_tpu.types.block import BlockID, PartSetHeader
+
+    def _paired_best(fa, fb, reps=9):
+        best_a = best_b = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fa()
+            t1 = time.perf_counter()
+            fb()
+            t2 = time.perf_counter()
+            best_a = min(best_a, t1 - t0)
+            best_b = min(best_b, t2 - t1)
+        return best_a, best_b
+
+    out: dict = {"leaves": {}}
+    device_on = False
+    try:
+        from tendermint_tpu.crypto.tpu import sha256 as dev_sha
+
+        device_on = dev_sha.device_enabled()
+        if device_on:
+            dev_sha.warmup()  # compile outside the timed windows
+    except Exception as e:  # noqa: BLE001 — device half is optional
+        log(f"merkle device warmup failed: {e!r}")
+        device_on = False
+
+    for n in (64, 1024, 16384):
+        leaves = [bytes([i % 256, (i >> 8) % 256]) * 125 for i in range(n)]
+        root_scalar = merkle.hash_from_byte_slices_scalar(leaves)
+        was = merkle.hashhub_active()
+        merkle.use_hashhub(True)
+        try:
+            assert merkle.hash_from_byte_slices(leaves) == root_scalar
+            ts, tb = _paired_best(
+                lambda: merkle.hash_from_byte_slices_scalar(leaves),
+                lambda: merkle.hash_from_byte_slices(leaves),
+            )
+            row = {
+                "scalar_leaves_per_s": round(n / ts, 1),
+                "batched_cpu_leaves_per_s": round(n / tb, 1),
+                "speedup": round(ts / tb, 2),
+            }
+            if device_on:
+                saved = hash_hub.MIN_DEVICE_BATCH
+                hash_hub.MIN_DEVICE_BATCH = 1
+                try:
+                    assert merkle.hash_from_byte_slices(leaves) == root_scalar
+                    _, td = _paired_best(
+                        lambda: None, lambda: merkle.hash_from_byte_slices(leaves)
+                    )
+                    row["device_leaves_per_s"] = round(n / td, 1)
+                    row["device_speedup"] = round(ts / td, 2)
+                finally:
+                    hash_hub.MIN_DEVICE_BATCH = saved
+        finally:
+            merkle.use_hashhub(was)
+        out["leaves"][str(n)] = row
+        log(
+            f"merkle {n:>6} leaves: scalar {row['scalar_leaves_per_s']:>12,.0f}/s "
+            f"batched {row['batched_cpu_leaves_per_s']:>12,.0f}/s "
+            f"-> {row['speedup']:.2f}x"
+            + (
+                f" device {row['device_leaves_per_s']:,.0f}/s"
+                if "device_leaves_per_s" in row
+                else ""
+            )
+        )
+
+    # -- block-hash/s: header root with memoization stripped per rep ----
+    bid = BlockID(b"\xab" * 32, PartSetHeader(4, b"\xcd" * 32))
+    sigs = tuple(
+        blk.CommitSig(
+            flag=blk.BLOCK_ID_FLAG_COMMIT,
+            validator_address=bytes([i % 256]) * 20,
+            timestamp_ns=1_700_000_000_000_000_000 + i,
+            signature=bytes([i % 256]) * 64,
+        )
+        for i in range(50)
+    )
+    commit = blk.Commit(height=2, round=0, block_id=bid, signatures=sigs)
+    hdr = blk.Header(
+        chain_id="bench",
+        height=3,
+        time_ns=1_700_000_000_000_000_000,
+        last_block_id=bid,
+        last_commit_hash=commit.hash(),
+        proposer_address=b"\x01" * 20,
+        validators_hash=b"\x02" * 32,
+        next_validators_hash=b"\x02" * 32,
+        app_hash=b"\x03" * 32,
+    )
+    iters = 2000
+    was = merkle.hashhub_active()
+
+    def _hash_headers():
+        # replace() yields a fresh frozen instance, dropping the memo —
+        # the 14-field tree build is what's measured
+        for _ in range(iters):
+            _dc_replace(hdr).hash()
+
+    try:
+        merkle.use_hashhub(False)
+        assert _dc_replace(hdr).hash() == _dc_replace(hdr).hash()
+        ref = _dc_replace(hdr).hash()
+        merkle.use_hashhub(True)
+        assert _dc_replace(hdr).hash() == ref, "builder A/B root mismatch"
+
+        def _scalar():
+            merkle.use_hashhub(False)
+            _hash_headers()
+
+        def _batched():
+            merkle.use_hashhub(True)
+            _hash_headers()
+
+        ts, tb = _paired_best(_scalar, _batched, reps=7)
+    finally:
+        merkle.use_hashhub(was)
+    out["block_hash"] = {
+        "scalar_per_s": round(iters / ts, 1),
+        "batched_per_s": round(iters / tb, 1),
+        "speedup": round(ts / tb, 2),
+    }
+    log(
+        f"merkle header-hash: scalar {out['block_hash']['scalar_per_s']:,.0f}/s "
+        f"batched {out['block_hash']['batched_per_s']:,.0f}/s "
+        f"-> {out['block_hash']['speedup']:.2f}x"
+    )
+
+    # -- chaos_soak blocks/s with the tree builder flipped ---------------
+    if os.environ.get("TMTPU_BENCH_MERKLE_SOAK") != "0":
+        from tendermint_tpu.consensus import scenarios as sc
+
+        seed = int(os.environ.get("TMTPU_BENCH_SOAK_SEED", "7") or 7)
+        was = merkle.hashhub_active()
+        soak: dict = {"n_vals": soak_vals, "seed": seed, "scenario": "baseline"}
+        try:
+            for label, enabled in (("scalar", False), ("hashhub", True)):
+                merkle.use_hashhub(enabled)
+
+                async def one(_n=soak_vals):
+                    return await sc.run_scenario(
+                        "baseline",
+                        n_vals=_n,
+                        target_height=2,
+                        seed=seed,
+                        timeout_s=300.0,
+                        stall_s=90.0,
+                        time_scale=4.0,
+                        degree=8,
+                    )
+
+                t0 = time.perf_counter()
+                try:
+                    res = asyncio.run(
+                        asyncio.wait_for(one(), 360.0)
+                    ).as_dict()
+                except Exception as e:  # noqa: BLE001 — structured outcome
+                    res = {"outcome": f"error: {e!r}"[:200]}
+                res["wall_s"] = round(time.perf_counter() - t0, 2)
+                soak[label] = res
+                log(
+                    f"merkle soak[{label}] {res.get('outcome', '?')} "
+                    f"{res.get('blocks_per_s', 0)} blk/s "
+                    f"wall={res['wall_s']}s"
+                )
+            bs = soak.get("scalar", {}).get("blocks_per_s") or 0
+            bh = soak.get("hashhub", {}).get("blocks_per_s") or 0
+            soak["soak_speedup"] = round(bh / bs, 2) if bs else None
+        finally:
+            merkle.use_hashhub(was)
+        out["chaos_soak_ab"] = soak
+    out["hashhub_stats"] = hash_hub.stats_snapshot()
+    return out
+
+
 def bench_byz_soak(sizes: tuple = (4, 50)) -> dict:
     """byz_soak config: Byzantine strategies over real routers measured
     per round — blocks/s under each traitor strategy, time-to-evidence-
@@ -2545,6 +2744,17 @@ def main() -> None:
             extra["wiregen"] = bench_wiregen(wg_vals)
         except Exception as e:  # noqa: BLE001
             log(f"wiregen bench failed: {e!r}")
+    # merkle runs on BOTH backends, BOUNDED: the HashHub level-order
+    # batched tree builder A/B'd against the scalar recursive reference
+    # — leaves/s at 64/1k/16k, header-hash/s, and chaos_soak blocks/s
+    # with the builder flipped. CPU-half is the acceptance number; the
+    # device bucket route engages only under TMTPU_HASH_TPU=1.
+    if os.environ.get("TMTPU_BENCH_MERKLE") != "0":
+        try:
+            mk_vals = int(os.environ.get("TMTPU_BENCH_MERKLE_VALS", "50"))
+            extra["merkle"] = bench_merkle(mk_vals)
+        except Exception as e:  # noqa: BLE001
+            log(f"merkle bench failed: {e!r}")
     # byz_soak runs on BOTH backends, BOUNDED: Byzantine strategies over
     # real routers — blocks/s per strategy, time-to-evidence-commit,
     # and the cross-node safety auditor's verdict at 4 and 50

@@ -3,16 +3,53 @@
 Leaf hash = SHA-256(0x00 || leaf), inner hash = SHA-256(0x01 || left || right),
 empty tree hash = SHA-256(""). Trees are unbalanced with the split at the
 largest power of two strictly less than n, which makes proofs logarithmic and
-append-friendly."""
+append-friendly.
+
+Tree construction is LEVEL-ORDER through the HashHub: each level of the
+tree is ONE `hash_hub.sha256_many` batch instead of O(n) recursive
+Python frames with list slicing — the hot-loop win `bench.py merkle`
+measures, and the shape the opt-in device kernel wants (a level of
+65-byte inner nodes is one uniform bucket). The level-order pass pairs
+nodes left-to-right and PROMOTES an odd last node unhashed; that
+produces bit-identical roots and proofs to the recursive
+largest-power-of-two-split builder (the left subtree of the split is
+complete, so pairing never crosses the split boundary — pinned
+exhaustively in tests/test_hash_hub.py, n = 0..1025 including every
+2^k±1 shape).
+
+The scalar recursive builders survive as `*_scalar`: the reference
+semantics, the A/B baseline, and the TMTPU_HASHHUB=0 kill switch
+(`use_hashhub` — the WireGen adoption pattern, but flag-dispatch
+instead of rebinding because callers import these functions by name)."""
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
+from .hash_hub import sha256_many as _sha256_many
 from .hashes import sha256
 
 LEAF_PREFIX = b"\x00"
 INNER_PREFIX = b"\x01"
+
+#: batched level-order construction is the default; TMTPU_HASHHUB=0 (or
+#: use_hashhub(False)) pins the scalar recursive reference paths
+_BATCHED = os.environ.get("TMTPU_HASHHUB", "1") != "0"
+
+
+def use_hashhub(enabled: bool) -> None:
+    """Flip between batched level-order and scalar recursive tree
+    construction at runtime (bench A/B + the kill switch). A module
+    flag rather than WireGen-style rebinding: `types/validator_set`
+    and friends import `hash_from_byte_slices` by name, so a rebound
+    global would silently strand those call sites on the old path."""
+    global _BATCHED
+    _BATCHED = bool(enabled)
+
+
+def hashhub_active() -> bool:
+    return _BATCHED
 
 # Proofs arrive from untrusted peers (light client, statesync): depth is
 # logarithmic in tree size, so anything past 100 aunts (reference
@@ -37,8 +74,31 @@ def _split_point(n: int) -> int:
     return k
 
 
-def hash_from_byte_slices(items: list[bytes]) -> bytes:
-    """Root hash of the merkle tree over `items` (reference crypto/merkle/tree.go:11)."""
+def hash_from_byte_slices(items: list[bytes], *, lane: str | None = None) -> bytes:
+    """Root hash of the merkle tree over `items` (reference crypto/merkle/tree.go:11).
+
+    Level-order batched through the HashHub by default; `lane` tags the
+    hub accounting (ambient `hash_hub.lane_ctx` when omitted)."""
+    if not _BATCHED:
+        return hash_from_byte_slices_scalar(items)
+    n = len(items)
+    if n == 0:
+        return sha256(b"")
+    level = _sha256_many([LEAF_PREFIX + it for it in items], lane=lane)
+    while len(level) > 1:
+        odd = len(level) & 1
+        pair = iter(level)
+        nxt = _sha256_many(
+            [INNER_PREFIX + a + b for a, b in zip(pair, pair)], lane=lane
+        )
+        if odd:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
+
+
+def hash_from_byte_slices_scalar(items: list[bytes]) -> bytes:
+    """The recursive reference builder (kill switch + A/B baseline)."""
     n = len(items)
     if n == 0:
         return sha256(b"")
@@ -46,7 +106,8 @@ def hash_from_byte_slices(items: list[bytes]) -> bytes:
         return _leaf_hash(items[0])
     k = _split_point(n)
     return _inner_hash(
-        hash_from_byte_slices(items[:k]), hash_from_byte_slices(items[k:])
+        hash_from_byte_slices_scalar(items[:k]),
+        hash_from_byte_slices_scalar(items[k:]),
     )
 
 
@@ -60,10 +121,16 @@ class Proof:
     leaf_hash: bytes
     aunts: list[bytes]
 
-    def verify(self, root: bytes, leaf: bytes) -> bool:
+    def verify(
+        self, root: bytes, leaf: bytes, *, leaf_hash: bytes | None = None
+    ) -> bool:
+        """`leaf_hash`, when given, must be SHA-256(0x00||leaf) computed
+        by the CALLER from the same bytes (the part-set receive path
+        caches it on the Part) — it skips the redundant re-derivation,
+        not the check against the proof's pinned leaf hash."""
         if self.total < 0 or not 0 <= self.index < max(self.total, 1):
             return False
-        if _leaf_hash(leaf) != self.leaf_hash:
+        if (leaf_hash if leaf_hash is not None else _leaf_hash(leaf)) != self.leaf_hash:
             return False
         computed = _compute_root(self.leaf_hash, self.index, self.total, self.aunts)
         return computed == root
@@ -123,8 +190,50 @@ def _compute_root(leaf_hash: bytes, index: int, total: int, aunts: list[bytes]) 
     return _inner_hash(aunts[-1], right)
 
 
-def proofs_from_byte_slices(items: list[bytes]) -> tuple[bytes, list[Proof]]:
-    """Build the tree and an inclusion proof per item."""
+def proofs_from_byte_slices(
+    items: list[bytes], *, lane: str | None = None
+) -> tuple[bytes, list[Proof]]:
+    """Build the tree and an inclusion proof per item.
+
+    Level-order like `hash_from_byte_slices`: leaf positions are
+    tracked up the tree (sibling = pos^1 while the node is paired at
+    this level; a promoted odd-last ancestor contributes no aunt), so
+    aunts come out nearest-first — the same order the recursive builder
+    produces as its recursion unwinds."""
+    if not _BATCHED:
+        return proofs_from_byte_slices_scalar(items)
+    n = len(items)
+    if n == 0:
+        return sha256(b""), []
+    leaf_hashes = _sha256_many([LEAF_PREFIX + it for it in items], lane=lane)
+    aunts: list[list[bytes]] = [[] for _ in range(n)]
+    pos = list(range(n))  # pos[i]: index of leaf i's ancestor in `level`
+    level = leaf_hashes
+    while len(level) > 1:
+        paired = len(level) & ~1
+        for i in range(n):
+            p = pos[i]
+            if p < paired:
+                aunts[i].append(level[p ^ 1])
+                pos[i] = p >> 1
+            else:  # promoted unhashed — no aunt at this level
+                pos[i] = paired >> 1
+        pair = iter(level)
+        nxt = _sha256_many(
+            [INNER_PREFIX + a + b for a, b in zip(pair, pair)], lane=lane
+        )
+        if len(level) > paired:
+            nxt.append(level[-1])
+        level = nxt
+    proofs = [
+        Proof(total=n, index=i, leaf_hash=leaf_hashes[i], aunts=aunts[i])
+        for i in range(n)
+    ]
+    return level[0], proofs
+
+
+def proofs_from_byte_slices_scalar(items: list[bytes]) -> tuple[bytes, list[Proof]]:
+    """The recursive reference builder (kill switch + A/B baseline)."""
     n = len(items)
     leaf_hashes = [_leaf_hash(it) for it in items]
 

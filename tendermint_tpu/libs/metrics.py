@@ -498,6 +498,43 @@ class NodeMetrics:
             "scheme_sigs",
             "signatures dispatched per signature scheme partition",
         )
+        # hash hub (crypto/hash_hub.py — the SHA-256 chokepoint; folded
+        # from the module STATS at render time like bls/resilience)
+        self.hashhub_batches = r.counter(
+            "hashhub", "batches", "sha256_many calls (one per merkle tree level)"
+        )
+        self.hashhub_messages = r.counter(
+            "hashhub", "messages", "messages hashed through batch calls"
+        )
+        self.hashhub_singles = r.counter(
+            "hashhub", "singles", "sha256_one calls (tx keys, leaf-hash cache fills)"
+        )
+        self.hashhub_occupancy = r.gauge(
+            "hashhub", "batch_occupancy", "mean messages per sha256_many call"
+        )
+        self.hashhub_max_batch = r.gauge(
+            "hashhub", "max_batch", "widest batch seen (bucket-ladder headroom)"
+        )
+        self.hashhub_device_batches = r.counter(
+            "hashhub", "device_batches", "batches served by the JAX kernel"
+        )
+        self.hashhub_device_messages = r.counter(
+            "hashhub", "device_messages", "messages hashed on the device route"
+        )
+        self.hashhub_fallbacks = r.counter(
+            "hashhub", "fallbacks",
+            "device batches re-hashed inline with hashlib after a backend error",
+        )
+        self.hashhub_breaker_skips = r.counter(
+            "hashhub", "breaker_skips",
+            "device-eligible batches kept on the host by the open TPU breaker",
+        )
+        self.hashhub_lane_batches = r.counter(
+            "hashhub", "lane_batches", "sha256_many calls per lane"
+        )
+        self.hashhub_lane_messages = r.counter(
+            "hashhub", "lane_messages", "messages hashed per lane (singles included)"
+        )
         # remote verification sidecar, client side (crypto/verifyd.py —
         # module-level stores like RESILIENCE: the remote route is
         # process-wide, shared by every in-process hub)
@@ -940,7 +977,33 @@ class NodeMetrics:
         self._fold_steps()
         self._fold_backend()
         self._fold_bls()
+        self._fold_hashhub()
         return self.registry.render()
+
+    def _fold_hashhub(self) -> None:
+        # same lazy-import contract as _fold_bls: the hub module loads
+        # with crypto anyway, but a scrape must never be the importer
+        import sys
+
+        hh = sys.modules.get("tendermint_tpu.crypto.hash_hub")
+        if hh is None:
+            return
+        s = hh.STATS
+        self.hashhub_batches._values[()] = s["batches"]
+        self.hashhub_messages._values[()] = s["messages"]
+        self.hashhub_singles._values[()] = s["singles"]
+        self.hashhub_occupancy.set(
+            round(s["messages"] / s["batches"], 3) if s["batches"] else 0.0
+        )
+        self.hashhub_max_batch.set(s["max_batch"])
+        self.hashhub_device_batches._values[()] = s["device_batches"]
+        self.hashhub_device_messages._values[()] = s["device_messages"]
+        self.hashhub_fallbacks._values[()] = s["fallback_batches"]
+        self.hashhub_breaker_skips._values[()] = s["breaker_skips"]
+        for lane, n in s["lane_batches"].items():
+            self.hashhub_lane_batches._values[(("lane", lane),)] = n
+        for lane, n in s["lane_messages"].items():
+            self.hashhub_lane_messages._values[(("lane", lane),)] = n
 
     def _fold_bls(self) -> None:
         # only fold when the BLS module is already loaded: importing it

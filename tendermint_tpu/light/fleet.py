@@ -194,20 +194,23 @@ def make_hop_proof(block: LightBlock, *, aggregate_hops: bool = True) -> HopProo
     transformation — `types.block.aggregate_commit` sums the very
     signatures the validators gossiped), the per-signature form
     otherwise (mixed/Edwards committees — the fallback)."""
-    commit = block.signed_header.commit
-    if aggregate_hops:
-        try:
-            agg = aggregate_commit(commit, block.validators)
-            if agg is not commit:
-                block = LightBlock(
-                    SignedHeader(block.header, agg), block.validators
-                )
+    from ..crypto import hash_hub
+
+    with hash_hub.lane_ctx(hash_hub.LANE_LIGHT):
+        commit = block.signed_header.commit
+        if aggregate_hops:
+            try:
+                agg = aggregate_commit(commit, block.validators)
+                if agg is not commit:
+                    block = LightBlock(
+                        SignedHeader(block.header, agg), block.validators
+                    )
+                return HopProof(block, SCHEME_AGGREGATE)
+            except ValueError:
+                pass  # non-BLS committee: per-sig fallback below
+        if commit.is_aggregate():
             return HopProof(block, SCHEME_AGGREGATE)
-        except ValueError:
-            pass  # non-BLS committee: per-sig fallback below
-    if commit.is_aggregate():
-        return HopProof(block, SCHEME_AGGREGATE)
-    return HopProof(block, SCHEME_PER_SIG)
+        return HopProof(block, SCHEME_PER_SIG)
 
 
 def verify_hop_proof(
@@ -226,19 +229,22 @@ def verify_hop_proof(
     and the batched per-sig path otherwise. Raises `HopProofError`
     carrying the scheme tag, so a tampered aggregate is attributable to
     the pairing path and a tampered signature to the per-sig path."""
-    proof.validate_basic(chain_id)
-    try:
-        verifier.verify(
-            chain_id,
-            trusted,
-            proof.block,
-            trusting_period_ns,
-            now_ns,
-            trust_level=trust_level,
-        )
-    except verifier.VerificationError as e:
-        raise HopProofError(proof.scheme, str(e)) from e
-    return proof.block
+    from ..crypto import hash_hub
+
+    with hash_hub.lane_ctx(hash_hub.LANE_LIGHT):
+        proof.validate_basic(chain_id)
+        try:
+            verifier.verify(
+                chain_id,
+                trusted,
+                proof.block,
+                trusting_period_ns,
+                now_ns,
+                trust_level=trust_level,
+            )
+        except verifier.VerificationError as e:
+            raise HopProofError(proof.scheme, str(e)) from e
+        return proof.block
 
 
 class _HopProvider(Provider):

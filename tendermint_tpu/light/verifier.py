@@ -19,6 +19,7 @@ from __future__ import annotations
 import time
 from fractions import Fraction
 
+from ..crypto import hash_hub
 from ..types.validation import (
     InvalidCommitError,
     verify_commit_light,
@@ -98,20 +99,21 @@ def verify_adjacent(
 ) -> None:
     """Reference VerifyAdjacent verifier.go:103."""
     now_ns = time.time_ns() if now_ns is None else now_ns
-    _check_adjacent_link(
-        chain_id, trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
-    )
-    try:
-        verify_commit_light(
-            chain_id,
-            untrusted.validators,
-            untrusted.signed_header.commit.block_id,
-            untrusted.height,
-            untrusted.signed_header.commit,
-            lane="backfill",
+    with hash_hub.lane_ctx(hash_hub.LANE_LIGHT):
+        _check_adjacent_link(
+            chain_id, trusted, untrusted, trusting_period_ns, now_ns, max_clock_drift_ns
         )
-    except InvalidCommitError as e:
-        raise VerificationError(f"invalid commit: {e}") from e
+        try:
+            verify_commit_light(
+                chain_id,
+                untrusted.validators,
+                untrusted.signed_header.commit.block_id,
+                untrusted.height,
+                untrusted.signed_header.commit,
+                lane="backfill",
+            )
+        except InvalidCommitError as e:
+            raise VerificationError(f"invalid commit: {e}") from e
 
 
 def verify_adjacent_chain(
@@ -140,28 +142,29 @@ def verify_adjacent_chain(
     Returns the new trusted head (the last block of `chain`). Raises
     VerificationError naming the offending height otherwise."""
     now_ns = time.time_ns() if now_ns is None else now_ns
-    entries = []
-    prev = trusted
-    for lb in chain:
-        _check_adjacent_link(
-            chain_id, prev, lb, trusting_period_ns, now_ns, max_clock_drift_ns
-        )
-        entries.append(
-            (
-                lb.validators,
-                lb.signed_header.commit.block_id,
-                lb.height,
-                lb.signed_header.commit,
+    with hash_hub.lane_ctx(hash_hub.LANE_LIGHT):
+        entries = []
+        prev = trusted
+        for lb in chain:
+            _check_adjacent_link(
+                chain_id, prev, lb, trusting_period_ns, now_ns, max_clock_drift_ns
             )
-        )
-        prev = lb
-    try:
-        verify_commit_range(chain_id, entries, lane="backfill")
-    except InvalidCommitError as e:
-        idx = getattr(e, "failed_index", None)
-        at = f" at height {chain[idx].height}" if idx is not None else ""
-        raise VerificationError(f"invalid commit{at}: {e}") from e
-    return prev
+            entries.append(
+                (
+                    lb.validators,
+                    lb.signed_header.commit.block_id,
+                    lb.height,
+                    lb.signed_header.commit,
+                )
+            )
+            prev = lb
+        try:
+            verify_commit_range(chain_id, entries, lane="backfill")
+        except InvalidCommitError as e:
+            idx = getattr(e, "failed_index", None)
+            at = f" at height {chain[idx].height}" if idx is not None else ""
+            raise VerificationError(f"invalid commit{at}: {e}") from e
+        return prev
 
 
 def verify_non_adjacent(
@@ -181,31 +184,32 @@ def verify_non_adjacent(
         )
     if _expired(trusted, trusting_period_ns, now_ns):
         raise VerificationError("trusted header has expired")
-    _validate_untrusted(chain_id, trusted, untrusted, now_ns, max_clock_drift_ns)
-    # the trusted validator set must still control trust_level of the new
-    # commit (verifier.go:67)
-    try:
-        verify_commit_light_trusting(
-            chain_id,
-            trusted.validators,
-            untrusted.signed_header.commit,
-            trust_level,
-            lane="backfill",
-        )
-    except InvalidCommitError as e:
-        raise ErrNewValSetCantBeTrusted(str(e)) from e
-    # and the new set must verify its own commit (verifier.go:82)
-    try:
-        verify_commit_light(
-            chain_id,
-            untrusted.validators,
-            untrusted.signed_header.commit.block_id,
-            untrusted.height,
-            untrusted.signed_header.commit,
-            lane="backfill",
-        )
-    except InvalidCommitError as e:
-        raise VerificationError(f"invalid commit: {e}") from e
+    with hash_hub.lane_ctx(hash_hub.LANE_LIGHT):
+        _validate_untrusted(chain_id, trusted, untrusted, now_ns, max_clock_drift_ns)
+        # the trusted validator set must still control trust_level of the new
+        # commit (verifier.go:67)
+        try:
+            verify_commit_light_trusting(
+                chain_id,
+                trusted.validators,
+                untrusted.signed_header.commit,
+                trust_level,
+                lane="backfill",
+            )
+        except InvalidCommitError as e:
+            raise ErrNewValSetCantBeTrusted(str(e)) from e
+        # and the new set must verify its own commit (verifier.go:82)
+        try:
+            verify_commit_light(
+                chain_id,
+                untrusted.validators,
+                untrusted.signed_header.commit.block_id,
+                untrusted.height,
+                untrusted.signed_header.commit,
+                lane="backfill",
+            )
+        except InvalidCommitError as e:
+            raise VerificationError(f"invalid commit: {e}") from e
 
 
 def verify(

@@ -59,7 +59,7 @@ from dataclasses import dataclass
 from ..config import MempoolIngressConfig
 from ..crypto import pubkey_from_type_and_bytes
 from ..crypto import verify_hub as vh
-from ..crypto.hashes import sha256
+from ..crypto.hash_hub import sha256_one
 from ..libs import protoenc as pe
 from ..libs import trace
 from ..libs.clock import SYSTEM, Clock
@@ -372,7 +372,7 @@ class TxIngress(Service):
         if len(tx) > self.mempool.config.max_tx_bytes:
             self.stats["rejected"] += 1
             return _fail(fut, TxRejectedError(0, f"tx too large ({len(tx)} bytes)"))
-        h = sha256(tx)
+        h = sha256_one(tx)
         pending = self._pending.get(h)
         if pending is not None:
             # already in the pipeline: remember the extra source so the

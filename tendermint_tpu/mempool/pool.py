@@ -22,7 +22,7 @@ from dataclasses import dataclass, field
 from ..abci import types as abci
 from ..abci.client import Client
 from ..config import MempoolConfig
-from ..crypto.hashes import sha256
+from ..crypto.hash_hub import sha256_one
 from ..libs import trace
 from . import Mempool
 
@@ -51,7 +51,7 @@ class TxCache:
 
     def push(self, tx: bytes) -> bool:
         """Returns False if already present (and refreshes recency)."""
-        key = sha256(tx)
+        key = sha256_one(tx)
         if key in self._map:
             self._map.move_to_end(key)
             return False
@@ -61,10 +61,10 @@ class TxCache:
         return True
 
     def remove(self, tx: bytes) -> None:
-        self._map.pop(sha256(tx), None)
+        self._map.pop(sha256_one(tx), None)
 
     def has(self, tx: bytes) -> bool:
-        return sha256(tx) in self._map
+        return sha256_one(tx) in self._map
 
     def reset(self) -> None:
         self._map.clear()
@@ -159,7 +159,7 @@ class PriorityMempool(Mempool):
             raise TxInCacheError("tx already committed")
         if not self.cache.push(tx):
             # seen before: record the extra gossip sender, reject
-            wtx = self._txs.get(sha256(tx))
+            wtx = self._txs.get(sha256_one(tx))
             if wtx is not None and sender:
                 wtx.peers.add(sender)
             raise TxInCacheError("tx already in cache")
@@ -195,7 +195,7 @@ class PriorityMempool(Mempool):
                 raise TxInCacheError("tx committed during admission")
             wtx = WrappedTx(
                 tx=tx,
-                hash=sha256(tx),
+                hash=sha256_one(tx),
                 height=self.height,
                 priority=res.priority,
                 gas_wanted=res.gas_wanted,
@@ -290,7 +290,7 @@ class PriorityMempool(Mempool):
                 self._committed.push(tx)
             else:
                 self.cache.remove(tx)
-            self._remove(sha256(tx), remove_from_cache=False)
+            self._remove(sha256_one(tx), remove_from_cache=False)
         if recheck and self.config.recheck and self._txs:
             await self._recheck()
         if self.size() > 0:

@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 import logging
 
-from ..crypto.hashes import sha256
+from ..crypto.hash_hub import sha256_one
 from ..libs.pubsub import Query
 from ..libs.service import Service
 from ..store.db import DB
@@ -63,7 +63,7 @@ class TxResult:
 
     @property
     def hash(self) -> bytes:
-        return sha256(self.tx)
+        return sha256_one(self.tx)
 
     def to_json(self) -> bytes:
         return json.dumps(

@@ -178,6 +178,49 @@ class VerifyChokepoint(Rule):
         )
 
 
+class HashChokepoint(Rule):
+    id = "hash-chokepoint"
+    doc = (
+        "no raw SHA-256 (`hashlib.sha256` / `crypto.hashes.sha256`) in "
+        "hot paths outside crypto/ — route through crypto/hash_hub "
+        "(`sha256_many` for batches, `sha256_one` for singles) so "
+        "hashing rides the lane accounting, hashhub_* metrics, and the "
+        "breaker-guarded device route; crypto/ stays the sink"
+    )
+    #: the hash hot paths: block/part/tx hashing (types/), app-hash and
+    #: indexing (state/), the consensus loop, the tx front door
+    #: (mempool/), and LightD hop serving (light/). crypto/ is the sink
+    #: and is out of scope by construction.
+    scope = (
+        "tendermint_tpu/types/",
+        "tendermint_tpu/state/",
+        "tendermint_tpu/consensus/",
+        "tendermint_tpu/mempool/",
+        "tendermint_tpu/light/",
+    )
+    profiles = ("node",)
+
+    def check(self, ctx: FileContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # resolve_call canonicalizes `import hashlib as h` /
+            # `from hashlib import sha256 as s`; relative imports
+            # (`from ..crypto.hashes import sha256`) stay bare, so the
+            # short name is what identifies the primitive either way
+            name = ctx.resolve_call(node)
+            if name is None or name.rsplit(".", 1)[-1] != "sha256":
+                continue
+            yield ctx.finding(
+                self.id,
+                node,
+                f"raw `{name}()` in a hash hot path bypasses the HashHub "
+                "(lane accounting, hashhub_* metrics, breaker-guarded "
+                "device batching); route through crypto/hash_hub."
+                "sha256_many / sha256_one — or crypto/merkle for trees",
+            )
+
+
 class FsDiscipline(Rule):
     id = "fs-discipline"
     doc = (
@@ -279,4 +322,4 @@ class ShapeBucketing(Rule):
             )
 
 
-RULES = (VerifyChokepoint(), FsDiscipline(), ShapeBucketing())
+RULES = (VerifyChokepoint(), HashChokepoint(), FsDiscipline(), ShapeBucketing())

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field, replace
 
-from ..crypto.hashes import sha256, HASH_SIZE
+from ..crypto.hashes import HASH_SIZE
 from ..crypto import merkle
 from ..libs import protoenc as pe
 from .canonical import vote_sign_bytes, encode_timestamp
@@ -382,6 +382,9 @@ class Header:
         in tests/test_light_mbt.py and tests/test_golden_vectors.py."""
         if not self.validators_hash:
             return b""
+        cached = self.__dict__.get("_hash")
+        if cached is not None:
+            return cached
 
         def cdc(b: bytes) -> bytes:  # gogotypes.BytesValue, empty -> nil
             return pe.bytes_field(1, b)
@@ -402,7 +405,12 @@ class Header:
             cdc(self.evidence_hash),
             cdc(self.proposer_address),
         ]
-        return merkle.hash_from_byte_slices(fields)
+        # memoized on the frozen instance: consensus, gossip keying,
+        # stores, and light verification all re-ask for the same header
+        # hash; the fields can't change, so the root can't either
+        root = merkle.hash_from_byte_slices(fields)
+        self.__dict__["_hash"] = root
+        return root
 
     def encode(self) -> bytes:
         out = pe.varint_field(1, self.version)
@@ -482,6 +490,17 @@ class Block:
     def hash(self) -> bytes:
         return self.header.hash()
 
+    def txs_hash(self) -> bytes:
+        """Tx merkle root, memoized on the frozen block (the same
+        shape as Header.hash()): the proposer computes it building the
+        header and every validator recomputes it in validate_basic —
+        one tree build per Block instance is enough."""
+        cached = self.__dict__.get("_txs_hash")
+        if cached is None:
+            cached = txs_hash(self.txs)
+            self.__dict__["_txs_hash"] = cached
+        return cached
+
     def block_id(self, part_set_header: PartSetHeader) -> BlockID:
         return BlockID(self.hash(), part_set_header)
 
@@ -539,5 +558,5 @@ class Block:
             self.last_commit.validate_basic()
             if self.header.last_commit_hash != self.last_commit.hash():
                 raise ValueError("last_commit_hash mismatch")
-        if self.header.data_hash != txs_hash(self.txs):
+        if self.header.data_hash != self.txs_hash():
             raise ValueError("data_hash mismatch")

@@ -144,10 +144,10 @@ class EventBus:
         )
 
     def publish_tx(self, data: EventDataTx) -> None:
-        from ..crypto.hashes import sha256
+        from ..crypto.hash_hub import sha256_one
 
         extra = abci_events_to_map(getattr(data.result, "events", ()))
-        extra.setdefault(TX_HASH_KEY, []).append(sha256(data.tx).hex().upper())
+        extra.setdefault(TX_HASH_KEY, []).append(sha256_one(data.tx).hex().upper())
         extra.setdefault(TX_HEIGHT_KEY, []).append(str(data.height))
         self._publish(EVENT_TX, data, extra)
 

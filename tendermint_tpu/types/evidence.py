@@ -56,7 +56,7 @@ class DuplicateVoteEvidence:
         # Safe on a frozen dataclass: the fields can never change.
         h = self.__dict__.get("_hash")
         if h is None:
-            h = sha256(self.encode())
+            h = sha256(self.encode())  # tmtlint: allow[hash-chokepoint] -- memoized single digest (one per evidence lifetime), nothing to batch
             object.__setattr__(self, "_hash", h)
         return h
 
@@ -149,6 +149,7 @@ class LightClientAttackEvidence:
         # frozen dataclass.
         h = self.__dict__.get("_hash")
         if h is None:
+            # tmtlint: allow[hash-chokepoint] -- memoized single digest over two small fields, nothing to batch
             h = sha256(
                 self.conflicting_block.header.hash()
                 + self.common_height.to_bytes(8, "big")

@@ -40,7 +40,7 @@ class ConsensusParams:
     validator: ValidatorParams = field(default_factory=ValidatorParams)
 
     def hash(self) -> bytes:
-        return sha256(self.encode())
+        return sha256(self.encode())  # tmtlint: allow[hash-chokepoint] -- one cold digest per params update, nothing to batch or account
 
     def encode(self) -> bytes:
         b = pe.varint_field(1, self.block.max_bytes) + pe.sfixed64_field(

@@ -20,6 +20,21 @@ class Part:
     bytes_: bytes
     proof: merkle.Proof
 
+    def leaf_hash(self) -> bytes:
+        """SHA-256(0x00||bytes_), computed once and cached: receive-side
+        proof verification and any re-gossip reuse one derivation
+        instead of re-hashing the 64 KB payload per consumer. Safe on
+        the frozen dataclass — bytes_ never changes."""
+        cached = self.__dict__.get("_leaf_hash")
+        if cached is None:
+            from ..crypto import hash_hub
+
+            cached = hash_hub.sha256_one(
+                merkle.LEAF_PREFIX + self.bytes_, lane=hash_hub.LANE_VERIFY
+            )
+            self.__dict__["_leaf_hash"] = cached
+        return cached
+
     def encode(self) -> bytes:
         out = pe.varint_field(1, self.index + 1)
         out += pe.bytes_field(2, self.bytes_)
@@ -46,11 +61,25 @@ class Part:
 class PartSet:
     @classmethod
     def from_data(cls, data: bytes, part_size: int = BLOCK_PART_SIZE) -> "PartSet":
+        from ..crypto import hash_hub
+
         chunks = [data[i : i + part_size] for i in range(0, len(data), part_size)] or [b""]
-        root, proofs = merkle.proofs_from_byte_slices(chunks)
+        root, proofs = merkle.proofs_from_byte_slices(
+            chunks, lane=hash_hub.LANE_BUILD
+        )
         ps = cls(PartSetHeader(total=len(chunks), hash=root))
+        # install directly: this process just BUILT the tree, so
+        # re-verifying every proof through add_part would re-derive each
+        # leaf hash from the 64 KB chunk it was computed from one line
+        # up (the redundant-rehash ISSUE 20 names). Receive-side parts
+        # still take the verifying add_part path.
         for i, (chunk, proof) in enumerate(zip(chunks, proofs)):
-            ps.add_part(Part(i, chunk, proof))
+            part = Part(i, chunk, proof)
+            part.__dict__["_leaf_hash"] = proof.leaf_hash
+            ps.parts[i] = part
+            ps.parts_bit_array.set(i, True)
+            ps.count += 1
+            ps.byte_size += len(chunk)
         return ps
 
     def __init__(self, header: PartSetHeader):
@@ -69,7 +98,11 @@ class PartSet:
             return False
         if part.proof.index != part.index or part.proof.total != self.header.total:
             raise ValueError("part proof position mismatch")
-        if not part.proof.verify(self.header.hash, part.bytes_):
+        # the cached leaf hash is derived from part.bytes_ itself, so
+        # passing it only skips the re-derivation, not the check
+        if not part.proof.verify(
+            self.header.hash, part.bytes_, leaf_hash=part.leaf_hash()
+        ):
             raise ValueError("invalid part proof")
         self.parts[part.index] = part
         self.parts_bit_array.set(part.index, True)

@@ -1,0 +1,416 @@
+"""HashHub (ISSUE 20): batched SHA-256/Merkle hot loop.
+
+Bit-identity is the contract everything here pins: the level-order
+batched tree builders must agree with the recursive reference builders
+for EVERY shape (the odd-last-node promotion equivalence), the device
+kernel must agree with hashlib for every message length it accepts, and
+every degrade path — breaker open, device error, kill switch — must
+return identical bytes, differing only in latency and accounting.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tendermint_tpu.crypto import batch as crypto_batch
+from tendermint_tpu.crypto import hash_hub, merkle
+from tendermint_tpu.libs import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _items(n: int, size: int = 37) -> list[bytes]:
+    # distinct, deterministic leaves; varying first bytes so a pairing
+    # bug can't accidentally cancel out
+    return [bytes([i & 0xFF, (i >> 8) & 0xFF]) + b"\xab" * size for i in range(n)]
+
+
+#: every tree shape class the promotion equivalence has to cover: the
+#: full small range (all pairing/promotion interleavings up to depth 7)
+#: plus 2^k and 2^k +/- 1 at larger depths
+TREE_SIZES = list(range(0, 70)) + [
+    127, 128, 129, 255, 256, 257, 511, 512, 513, 1023, 1024, 1025,
+]
+
+
+# ---------------------------------------------------------------------------
+# merkle bit-identity: batched level-order vs recursive reference
+
+
+def test_root_bit_identity_every_shape():
+    for n in TREE_SIZES:
+        items = _items(n)
+        assert merkle.hash_from_byte_slices(items) == \
+            merkle.hash_from_byte_slices_scalar(items), f"root mismatch at n={n}"
+
+
+def test_proofs_bit_identity_every_shape():
+    for n in TREE_SIZES:
+        items = _items(n)
+        root_b, proofs_b = merkle.proofs_from_byte_slices(items)
+        root_s, proofs_s = merkle.proofs_from_byte_slices_scalar(items)
+        assert root_b == root_s, f"proof root mismatch at n={n}"
+        assert len(proofs_b) == len(proofs_s) == n
+        for i, (pb, ps) in enumerate(zip(proofs_b, proofs_s)):
+            assert (pb.total, pb.index) == (ps.total, ps.index), (n, i)
+            assert pb.leaf_hash == ps.leaf_hash, (n, i)
+            assert pb.aunts == ps.aunts, f"aunts mismatch n={n} i={i}"
+
+
+def test_batched_proofs_verify_against_batched_root():
+    for n in (1, 2, 7, 14, 33, 129):
+        items = _items(n)
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        for i, p in enumerate(proofs):
+            assert p.verify(root, items[i])
+            # caller-supplied leaf hash skips re-derivation, same verdict
+            assert p.verify(root, items[i], leaf_hash=p.leaf_hash)
+            assert not p.verify(root, items[i] + b"x")
+            assert not p.verify(root, items[i], leaf_hash=b"\x00" * 32)
+
+
+def test_empty_tree_is_sha256_of_empty():
+    assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+    root, proofs = merkle.proofs_from_byte_slices([])
+    assert root == hashlib.sha256(b"").digest() and proofs == []
+
+
+# ---------------------------------------------------------------------------
+# sha256_many / sha256_one vs hashlib
+
+
+def test_sha256_many_matches_hashlib():
+    msgs = [bytes([i & 0xFF]) * (i % 97) for i in range(300)]
+    assert hash_hub.sha256_many(msgs) == [
+        hashlib.sha256(m).digest() for m in msgs
+    ]
+    assert hash_hub.sha256_many([]) == []
+
+
+def test_sha256_one_matches_hashlib():
+    assert hash_hub.sha256_one(b"abc") == hashlib.sha256(b"abc").digest()
+
+
+# ---------------------------------------------------------------------------
+# lanes + stats accounting
+
+
+def test_lane_accounting_explicit_and_ambient():
+    hash_hub.reset_stats()
+    assert hash_hub.current_lane() == hash_hub.LANE_BUILD
+    hash_hub.sha256_many([b"a", b"b"], lane=hash_hub.LANE_VERIFY)
+    with hash_hub.lane_ctx(hash_hub.LANE_LIGHT):
+        assert hash_hub.current_lane() == hash_hub.LANE_LIGHT
+        hash_hub.sha256_many([b"c"])
+        with hash_hub.lane_ctx(hash_hub.LANE_VERIFY):  # re-entrant
+            assert hash_hub.current_lane() == hash_hub.LANE_VERIFY
+        assert hash_hub.current_lane() == hash_hub.LANE_LIGHT
+    assert hash_hub.current_lane() == hash_hub.LANE_BUILD
+    hash_hub.sha256_one(b"d")
+    s = hash_hub.stats_snapshot()
+    assert s["batches"] == 2 and s["messages"] == 3 and s["singles"] == 1
+    assert s["lane_batches"] == {"build": 0, "verify": 1, "light": 1}
+    assert s["lane_messages"] == {"build": 1, "verify": 2, "light": 1}
+    assert s["max_batch"] == 2
+    hash_hub.reset_stats()
+
+
+def test_lane_ctx_rejects_unknown_lane():
+    with pytest.raises(ValueError):
+        hash_hub.lane_ctx("turbo")
+
+
+def test_merkle_tags_the_requested_lane():
+    hash_hub.reset_stats()
+    merkle.hash_from_byte_slices(_items(5), lane=hash_hub.LANE_LIGHT)
+    s = hash_hub.stats_snapshot()
+    assert s["lane_messages"]["light"] == s["messages"] > 0
+    hash_hub.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# kill switch: runtime flag + fresh-interpreter env
+
+
+def test_use_hashhub_runtime_flip():
+    items = _items(19)
+    was = merkle.hashhub_active()
+    try:
+        merkle.use_hashhub(False)
+        assert not merkle.hashhub_active()
+        root_off = merkle.hash_from_byte_slices(items)
+        _, proofs_off = merkle.proofs_from_byte_slices(items)
+        merkle.use_hashhub(True)
+        assert merkle.hashhub_active()
+        assert merkle.hash_from_byte_slices(items) == root_off
+        assert merkle.proofs_from_byte_slices(items)[1] == proofs_off
+    finally:
+        merkle.use_hashhub(was)
+
+
+def test_env_kill_switch_fresh_interpreter():
+    code = (
+        "from tendermint_tpu.crypto import merkle; "
+        "items = [bytes([i]) * 9 for i in range(21)]; "
+        "print(merkle.hashhub_active(), "
+        "merkle.hash_from_byte_slices(items) == "
+        "merkle.hash_from_byte_slices_scalar(items))"
+    )
+    for env_val, expect in (("0", "False True"), ("1", "True True")):
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            cwd=REPO,
+            env={**os.environ, "TMTPU_HASHHUB": env_val, "JAX_PLATFORMS": "cpu"},
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == expect
+
+
+# ---------------------------------------------------------------------------
+# device route (JAX-CPU backend stands in for the TPU)
+
+
+@pytest.fixture
+def device_on(monkeypatch):
+    """Opt the kernel route in and make every batch device-eligible."""
+    monkeypatch.setenv("TMTPU_HASH_TPU", "1")
+    monkeypatch.setattr(hash_hub, "MIN_DEVICE_BATCH", 4)
+    hash_hub._reset_device_probe()
+    breaker = crypto_batch.tpu_breaker()
+    breaker.record_success()  # start closed regardless of prior tests
+    yield
+    breaker.record_success()
+    hash_hub._reset_device_probe()
+
+
+def test_device_route_bit_identity(device_on):
+    from tendermint_tpu.crypto.tpu import sha256 as dev
+
+    # every padding boundary the packer has to get right: around the
+    # 55/56 one-block limit, the 64-byte block edge, multi-block sizes,
+    # and the 503-byte _MAX_BLOCKS ceiling
+    lengths = [0, 1, 54, 55, 56, 63, 64, 118, 119, 120, 127, 128, 200,
+               255, 256, 400, 503]
+    msgs = [bytes([ln & 0xFF]) * ln for ln in lengths]
+    assert dev.sha256_device(msgs) == [hashlib.sha256(m).digest() for m in msgs]
+
+
+def test_device_route_through_hub(device_on):
+    hash_hub.reset_stats()
+    msgs = [bytes([i % 251]) * (i % 120) for i in range(64)]
+    out = hash_hub.sha256_many(msgs)
+    assert out == [hashlib.sha256(m).digest() for m in msgs]
+    s = hash_hub.stats_snapshot()
+    assert s["device_batches"] == 1 and s["device_messages"] == 64
+    hash_hub.reset_stats()
+
+
+def test_long_messages_stay_on_host(device_on):
+    from tendermint_tpu.crypto.tpu import sha256 as dev
+
+    hash_hub.reset_stats()
+    big = b"\xcd" * (dev.max_device_bytes() + 1)
+    msgs = [big] * 8
+    assert hash_hub.sha256_many(msgs) == [
+        hashlib.sha256(m).digest() for m in msgs
+    ]
+    s = hash_hub.stats_snapshot()
+    assert s["device_batches"] == 0  # routed around the kernel, no error
+    assert s["fallback_batches"] == 0
+    with pytest.raises(ValueError):
+        dev.sha256_device(msgs)  # the kernel itself refuses over-limit
+    hash_hub.reset_stats()
+
+
+def test_breaker_open_skips_device_identical_bytes(device_on):
+    hash_hub.reset_stats()
+    breaker = crypto_batch.tpu_breaker()
+    for _ in range(breaker.failure_threshold):
+        breaker.record_failure()
+    assert breaker.state == "open"
+    msgs = [bytes([i]) * 30 for i in range(32)]
+    assert hash_hub.sha256_many(msgs) == [
+        hashlib.sha256(m).digest() for m in msgs
+    ]
+    s = hash_hub.stats_snapshot()
+    assert s["breaker_skips"] == 1 and s["device_batches"] == 0
+    breaker.record_success()
+    hash_hub.reset_stats()
+
+
+def test_device_error_degrades_to_host(device_on, monkeypatch):
+    from tendermint_tpu.crypto.tpu import sha256 as dev
+
+    hash_hub.reset_stats()
+
+    def boom(msgs):
+        raise RuntimeError("device wedged")
+
+    monkeypatch.setattr(dev, "sha256_device", boom)
+    msgs = [bytes([i]) * 30 for i in range(32)]
+    # latency, never correctness: the failed batch re-hashes inline
+    assert hash_hub.sha256_many(msgs) == [
+        hashlib.sha256(m).digest() for m in msgs
+    ]
+    s = hash_hub.stats_snapshot()
+    assert s["fallback_batches"] == 1 and s["device_batches"] == 0
+    crypto_batch.tpu_breaker().record_success()
+    hash_hub.reset_stats()
+
+
+def test_device_off_by_default():
+    # without TMTPU_HASH_TPU=1 the probe caches False and wide batches
+    # stay on the host loop
+    assert os.environ.get("TMTPU_HASH_TPU") != "1"
+    hash_hub._reset_device_probe()
+    assert hash_hub._device_module() is False
+
+
+# ---------------------------------------------------------------------------
+# trace spans: wide batches only
+
+
+def test_wide_batch_emits_hash_span(monkeypatch):
+    monkeypatch.setattr(hash_hub, "MIN_DEVICE_BATCH", 8)
+    before = len(trace.RECORDER.dump(subsystem="hash"))
+    hash_hub.sha256_many([b"x"] * 8, lane=hash_hub.LANE_VERIFY)
+    spans = trace.RECORDER.dump(subsystem="hash")
+    assert len(spans) == before + 1
+    last = spans[-1]
+    assert last["name"] == "batch"
+    assert last["attrs"]["n"] == 8
+    assert last["attrs"]["lane"] == "verify"
+    assert last["attrs"]["route"] in ("cpu", "tpu")
+    hash_hub.reset_stats()
+
+
+def test_narrow_batch_emits_no_span():
+    # a span per microseconds-scale merkle level would flood the ring
+    before = len(trace.RECORDER.dump(subsystem="hash"))
+    hash_hub.sha256_many([b"x"] * 4)
+    assert len(trace.RECORDER.dump(subsystem="hash")) == before
+    hash_hub.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# /metrics folding
+
+
+def test_metrics_fold_hashhub():
+    from tendermint_tpu.libs.metrics import NodeMetrics
+
+    hash_hub.reset_stats()
+    hash_hub.sha256_many([b"a", b"b", b"c"], lane=hash_hub.LANE_VERIFY)
+    hash_hub.sha256_one(b"d")
+    rendered = NodeMetrics().render()
+    assert "tendermint_tpu_hashhub_batches 1" in rendered
+    assert "tendermint_tpu_hashhub_messages 3" in rendered
+    assert "tendermint_tpu_hashhub_singles 1" in rendered
+    assert "tendermint_tpu_hashhub_batch_occupancy 3" in rendered
+    assert 'tendermint_tpu_hashhub_lane_batches{lane="verify"} 1' in rendered
+    assert "tendermint_tpu_hashhub_breaker_skips 0" in rendered
+    hash_hub.reset_stats()
+
+
+# ---------------------------------------------------------------------------
+# redundant-rehash fixes: part-set leaf cache, header/txs memoization
+
+
+def test_part_leaf_hash_cached_and_correct():
+    from tendermint_tpu.types.part_set import PartSet
+
+    ps = PartSet.from_data(b"\x01\x02" * 40000, part_size=65536)
+    part = ps.get_part(0)
+    expect = hashlib.sha256(merkle.LEAF_PREFIX + part.bytes_).digest()
+    first = part.leaf_hash()
+    assert first == expect == part.proof.leaf_hash
+    assert part.leaf_hash() is first  # cached, not re-derived
+
+
+def test_from_data_parts_pass_receive_side_verification():
+    from tendermint_tpu.types.part_set import Part, PartSet
+
+    data = bytes(range(256)) * 1024  # 4 parts at 64 KiB
+    ps = PartSet.from_data(data, part_size=65536)
+    assert ps.is_complete() and ps.assemble() == data
+    # a receiver reassembling from gossip runs the verifying add_part
+    # path over the same parts (fresh Part objects: no cached hash)
+    ps2 = PartSet(ps.header)
+    for i in range(ps.header.total):
+        p = ps.get_part(i)
+        assert ps2.add_part(Part(p.index, p.bytes_, p.proof))
+    assert ps2.assemble() == data
+    # and a corrupted payload still fails against the cached-hash path
+    bad = Part(0, b"evil" + ps.get_part(0).bytes_[4:], ps.get_part(0).proof)
+    with pytest.raises(ValueError):
+        PartSet(ps.header).add_part(bad)
+
+
+def test_partset_root_matches_scalar_builder():
+    from tendermint_tpu.types.part_set import PartSet
+
+    data = b"\x07" * 200000
+    was = merkle.hashhub_active()
+    try:
+        merkle.use_hashhub(True)
+        root_b = PartSet.from_data(data, part_size=65536).header.hash
+        merkle.use_hashhub(False)
+        root_s = PartSet.from_data(data, part_size=65536).header.hash
+    finally:
+        merkle.use_hashhub(was)
+    assert root_b == root_s
+
+
+def test_header_hash_memoized():
+    import dataclasses
+
+    from tendermint_tpu.types.block import Header, txs_hash
+
+    hdr = Header(
+        chain_id="memo-chain",
+        height=7,
+        time_ns=1,
+        data_hash=txs_hash((b"tx1", b"tx2")),
+        validators_hash=b"\x11" * 32,
+        next_validators_hash=b"\x11" * 32,
+        proposer_address=b"\x22" * 20,
+    )
+    h1 = hdr.hash()
+    assert hdr.hash() is h1  # second call returns the cached object
+    # replace() builds a fresh instance — no stale memo rides along
+    hdr2 = dataclasses.replace(hdr, height=8)
+    assert hdr2.hash() != h1
+    assert dataclasses.replace(hdr).hash() == h1
+
+
+def test_block_txs_hash_memoized():
+    from tendermint_tpu.types.block import (
+        Block, BlockID, Commit, Header, txs_hash,
+    )
+
+    txs = (b"a", b"bb", b"ccc")
+    blk = Block(
+        header=Header(
+            chain_id="memo-chain",
+            height=1,
+            time_ns=1,
+            data_hash=txs_hash(txs),
+            validators_hash=b"\x11" * 32,
+            next_validators_hash=b"\x11" * 32,
+            proposer_address=b"\x22" * 20,
+        ),
+        txs=txs,
+        last_commit=Commit(height=0, round=0, block_id=BlockID(), signatures=()),
+    )
+    t1 = blk.txs_hash()
+    assert t1 == txs_hash(txs)
+    assert blk.txs_hash() is t1
+    blk.validate_basic()  # consumes the memo, still validates

@@ -511,6 +511,116 @@ def test_verifyd_funnel_clean_cases():
 
 
 # ---------------------------------------------------------------------------
+# hash-chokepoint
+
+
+def test_raw_sha256_flagged_in_hash_hot_paths():
+    """ISSUE 20: raw hashlib in types/state/consensus/mempool/light
+    bypasses the HashHub (lane stats, metrics, device batching)."""
+    src = """
+    import hashlib
+    def tx_key(tx):
+        return hashlib.sha256(tx).digest()
+    """
+    for rel in (
+        "tendermint_tpu/types/tx.py",
+        "tendermint_tpu/state/execution.py",
+        "tendermint_tpu/consensus/state.py",
+        "tendermint_tpu/mempool/pool.py",
+        "tendermint_tpu/light/client.py",
+    ):
+        fs = run(src, "hash-chokepoint", rel=rel)
+        assert len(fs) == 1 and "HashHub" in fs[0].message, rel
+
+
+def test_sha256_via_import_alias_and_relative_import_flagged():
+    # resolve_call canonicalizes absolute aliases; relative imports stay
+    # bare — the short name catches the primitive either way
+    src = """
+    from hashlib import sha256 as s256
+    from ..crypto.hashes import sha256
+
+    def double(data):
+        return s256(sha256(data)).digest()
+    """
+    fs = run(src, "hash-chokepoint", rel="tendermint_tpu/types/block.py")
+    assert len(fs) == 2
+
+
+def test_hub_routes_and_crypto_sink_are_clean():
+    # the blessed funnel calls are exactly what the rule pushes toward
+    src = """
+    from ..crypto.hash_hub import sha256_many, sha256_one
+    from ..crypto import merkle
+
+    def roots(chunks, tx):
+        return merkle.hash_from_byte_slices(chunks), sha256_one(tx)
+    """
+    assert run(src, "hash-chokepoint", rel="tendermint_tpu/types/block.py") == []
+    # crypto/ is the sink: out of scope by construction, no pragma needed
+    raw = """
+    import hashlib
+    def digest(m):
+        return hashlib.sha256(m).digest()
+    """
+    assert run(raw, "hash-chokepoint", rel="tendermint_tpu/crypto/hashes.py") == []
+    # and non-hot trees (tools/, rpc/) are out of scope too
+    assert run(raw, "hash-chokepoint", rel="tendermint_tpu/tools/dumper.py") == []
+
+
+def test_hash_chokepoint_pragma_needs_reason():
+    flagged = """
+    import hashlib
+    def seed(label):
+        return hashlib.sha256(label).digest()  # tmtlint: allow[hash-chokepoint]
+    """
+    fs = lint_source(
+        textwrap.dedent(flagged),
+        "tendermint_tpu/consensus/chaos.py",
+        [RULES_BY_ID["hash-chokepoint"]],
+        known_rules=set(RULES_BY_ID),
+    )
+    assert {f.rule for f in fs} == {"hash-chokepoint", BAD_PRAGMA}
+    reasoned = """
+    import hashlib
+    def seed(label):
+        return hashlib.sha256(label).digest()  # tmtlint: allow[hash-chokepoint] -- fixture: derivation, not a hot path
+    """
+    assert run(reasoned, "hash-chokepoint", rel="tendermint_tpu/consensus/chaos.py") == []
+
+
+def test_hash_chokepoint_checked_in_allowlist():
+    # the seeded chaos/attack harnesses are exempted by prefix in
+    # allowlist.json — with the reason recorded there, not inline
+    src = """
+    import hashlib
+    def derive(label):
+        return hashlib.sha256(label).digest()
+    """
+    assert (
+        run(
+            src,
+            "hash-chokepoint",
+            rel="tendermint_tpu/consensus/byzantine.py",
+            allowlist=Allowlist.load(DEFAULT_ALLOWLIST),
+        )
+        == []
+    )
+    # the exemption is prefix-scoped: a neighbor file is still flagged
+    assert (
+        len(
+            run(
+                src,
+                "hash-chokepoint",
+                rel="tendermint_tpu/consensus/state.py",
+                allowlist=Allowlist.load(DEFAULT_ALLOWLIST),
+            )
+        )
+        == 1
+    )
+
+
+# ---------------------------------------------------------------------------
 # unbounded-queue
 
 
